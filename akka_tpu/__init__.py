@@ -1,0 +1,33 @@
+"""akka-tpu: a TPU-native actor framework with the capabilities of Akka 2.6.
+
+Not a port: the hot path (tell → receive) runs as batched, jitted JAX steps on
+TPU — actors are rows in SoA state tensors, message delivery is a segment-sum
+scatter over recipient ids, behaviors are vmapped update functions — while a
+host-side control plane keeps Akka's semantics for spawn/stop/supervision/
+cluster membership. See SURVEY.md for the reference map.
+
+Public surface (mirrors the reference's module split):
+- akka_tpu.actor      — ActorSystem, ActorRef, Props, classic actors
+- akka_tpu.typed      — Behavior/Behaviors typed API
+- akka_tpu.dispatch   — dispatchers incl. the flagship `tpu-batched`
+- akka_tpu.batched    — the SoA device runtime (BatchedSystem)
+- akka_tpu.routing / pattern / event / serialization
+- akka_tpu.remote / cluster / sharding / ddata / persistence / stream
+- akka_tpu.testkit    — TestProbe, BehaviorTestKit, multi-node harness
+"""
+
+__version__ = "0.1.0"
+
+from .config import Config, reference_config  # noqa: F401
+from .actor.system import ActorSystem, ExtensionId, CoordinatedShutdown  # noqa: F401
+from .actor.actor import Actor, Stash, FunctionActor  # noqa: F401
+from .actor.props import Props  # noqa: F401
+from .actor.ref import ActorRef, Nobody  # noqa: F401
+from .actor.path import ActorPath, Address  # noqa: F401
+from .actor.messages import (  # noqa: F401
+    PoisonPill, Kill, ReceiveTimeout, Terminated, Identify, ActorIdentity,
+    DeadLetter, Status, UnhandledMessage)
+from .actor.supervision import (  # noqa: F401
+    OneForOneStrategy, AllForOneStrategy, Resume, Restart, Stop, Escalate,
+    default_strategy, stopping_strategy)
+from .pattern.ask import ask, ask_sync, pipe, AskTimeoutException  # noqa: F401

@@ -1,0 +1,49 @@
+"""Props: immutable recipe for creating an actor.
+
+Reference parity: akka-actor/src/main/scala/akka/actor/Props.scala — class +
+constructor args + deploy info (dispatcher/mailbox/router selection, reference:
+actor/Deployer.scala).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Optional
+
+
+@dataclass(frozen=True)
+class Props:
+    factory: Callable[[], Any]                 # () -> Actor
+    cls: Optional[type] = None
+    dispatcher: Optional[str] = None           # dispatcher config id
+    mailbox: Optional[Any] = None              # mailbox name or MailboxType
+    router_config: Optional[Any] = None        # RouterConfig (akka_tpu.routing)
+
+    @staticmethod
+    def create(cls: type, *args, **kwargs) -> "Props":
+        return Props(factory=lambda: cls(*args, **kwargs), cls=cls)
+
+    @staticmethod
+    def from_factory(factory: Callable[[], Any], cls: Optional[type] = None) -> "Props":
+        return Props(factory=factory, cls=cls)
+
+    @staticmethod
+    def from_receive(receive: Callable[[Any, Any], None]) -> "Props":
+        """Props from a plain function receive(context, message)."""
+        from .actor import FunctionActor
+        return Props(factory=lambda: FunctionActor(receive), cls=FunctionActor)
+
+    def with_dispatcher(self, dispatcher_id: str) -> "Props":
+        return replace(self, dispatcher=dispatcher_id)
+
+    def with_mailbox(self, mailbox: Any) -> "Props":
+        return replace(self, mailbox=mailbox)
+
+    def with_router(self, router_config: Any) -> "Props":
+        return replace(self, router_config=router_config)
+
+    def new_actor(self) -> Any:
+        return self.factory()
+
+    def actor_class(self) -> Optional[type]:
+        return self.cls

@@ -1,0 +1,11 @@
+"""Test env: force CPU backend with a virtual 8-device mesh so multi-chip
+sharding tests run anywhere (SURVEY.md §4 TPU translation: multi-node tests
+on a simulated mesh via xla_force_host_platform_device_count)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
