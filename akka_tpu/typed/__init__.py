@@ -1,0 +1,21 @@
+"""Typed actor API (reference: akka-actor-typed).
+
+Usage:
+    from akka_tpu.typed import ActorSystem, Behaviors
+
+    def counter(count=0):
+        def on_message(ctx, msg):
+            if msg == "inc":
+                return counter(count + 1)
+            ...
+        return Behaviors.receive(on_message)
+
+    system = ActorSystem.create(counter(), "counter")
+"""
+
+from .behavior import (Behavior, Signal, PreRestart, PostStop, Terminated,  # noqa: F401
+                       ChildFailed)
+from .behaviors import (Behaviors, SupervisorStrategy, TimerScheduler,  # noqa: F401
+                        StashBuffer, StashException)
+from .adapter import TypedActorContext, props_from_behavior  # noqa: F401
+from .actor_system import ActorSystem  # noqa: F401

@@ -1,0 +1,207 @@
+"""Typed Behavior API tests (modeled on akka-actor-typed-tests suites:
+ActorSpec/SupervisionSpec/StashBufferSpec, SURVEY.md §2.2)."""
+
+import threading
+import time
+
+import pytest
+
+from akka_tpu.typed import (ActorSystem, Behaviors, PostStop, SupervisorStrategy,
+                            Terminated)
+
+
+@pytest.fixture()
+def tsystem():
+    sys = ActorSystem.create(Behaviors.empty, "typed-test",
+                             {"akka": {"stdout-loglevel": "OFF", "log-dead-letters": 0}})
+    yield sys
+    sys.terminate()
+    assert sys.await_termination(10.0)
+
+
+def test_counter_behavior(tsystem):
+    replies = []
+    got = threading.Event()
+
+    def counter(count=0):
+        def on_msg(ctx, msg):
+            if msg == "inc":
+                return counter(count + 1)
+            if isinstance(msg, tuple) and msg[0] == "get":
+                msg[1].tell(count)
+                return Behaviors.same
+            return Behaviors.unhandled
+        return Behaviors.receive(on_msg)
+
+    ref = tsystem.spawn(counter(), "counter")
+    for _ in range(5):
+        ref.tell("inc")
+    probe = tsystem.classic.provider.create_function_ref(
+        lambda msg, sender: (replies.append(msg), got.set()))
+    ref.tell(("get", probe))
+    assert got.wait(5.0)
+    assert replies == [5]
+
+
+def test_setup_and_stopped(tsystem):
+    stopped = threading.Event()
+    started = threading.Event()
+
+    def root():
+        def _setup(ctx):
+            started.set()
+
+            def on_msg(ctx, msg):
+                if msg == "stop":
+                    return Behaviors.stopped(lambda: stopped.set())
+                return Behaviors.same
+            return Behaviors.receive(on_msg)
+        return Behaviors.setup(_setup)
+
+    ref = tsystem.spawn(root())
+    deadline = time.monotonic() + 5
+    ref.tell("noop")
+    assert started.wait(5.0)
+    ref.tell("stop")
+    assert stopped.wait(5.0)
+
+
+def test_supervision_restart(tsystem):
+    starts = []
+
+    def flaky():
+        def _setup(ctx):
+            starts.append(1)
+
+            def on_msg(ctx, msg):
+                if msg == "boom":
+                    raise ValueError("boom")
+                return Behaviors.same
+            return Behaviors.receive(on_msg)
+        return Behaviors.setup(_setup)
+
+    b = Behaviors.supervise(flaky()).on_failure(SupervisorStrategy.restart())
+    ref = tsystem.spawn(b, "flaky")
+    ref.tell("ok")
+    time.sleep(0.1)
+    assert len(starts) == 1
+    ref.tell("boom")
+    time.sleep(0.3)
+    assert len(starts) == 2  # setup re-ran on restart
+    ref.tell("ok")  # still alive
+    time.sleep(0.1)
+
+
+def test_supervision_stop(tsystem):
+    stopped = threading.Event()
+
+    def flaky():
+        def on_msg(ctx, msg):
+            raise ValueError("die")
+        return Behaviors.receive(on_msg, lambda ctx, sig: (stopped.set(), Behaviors.same)[1]
+                                 if sig is PostStop else Behaviors.unhandled)
+
+    b = Behaviors.supervise(flaky()).on_failure(SupervisorStrategy.stop())
+    ref = tsystem.spawn(b)
+    ref.tell("x")
+    assert stopped.wait(5.0)
+
+
+def test_watch_terminated_signal(tsystem):
+    saw = threading.Event()
+
+    def watcher():
+        def _setup(ctx):
+            child = ctx.spawn(Behaviors.receive_message(
+                lambda m: Behaviors.stopped() if m == "die" else Behaviors.same), "child")
+            ctx.watch(child)
+            child.tell("die")
+
+            def on_sig(ctx, sig):
+                if isinstance(sig, Terminated):
+                    saw.set()
+                    return Behaviors.same
+                return Behaviors.unhandled
+            return Behaviors.receive(lambda ctx, m: Behaviors.same, on_sig)
+        return Behaviors.setup(_setup)
+
+    tsystem.spawn(watcher())
+    assert saw.wait(5.0)
+
+
+def test_timers(tsystem):
+    ticks = []
+    done = threading.Event()
+
+    def ticker():
+        def _factory(timers):
+            timers.start_timer_with_fixed_delay("tick", "tick", 0.05)
+
+            def on_msg(ctx, msg):
+                ticks.append(msg)
+                if len(ticks) >= 3:
+                    timers.cancel("tick")
+                    done.set()
+                return Behaviors.same
+            return Behaviors.receive(on_msg)
+        return Behaviors.with_timers(_factory)
+
+    tsystem.spawn(ticker())
+    assert done.wait(5.0)
+    assert ticks[:3] == ["tick", "tick", "tick"]
+
+
+def test_stash_buffer(tsystem):
+    processed = []
+    done = threading.Event()
+
+    def initializing():
+        def _factory(stash):
+            def waiting(ctx, msg):
+                if msg == "go":
+                    return stash.unstash_all(active())
+                stash.stash(msg)
+                return Behaviors.same
+
+            def active():
+                def on_msg(ctx, msg):
+                    processed.append(msg)
+                    if msg == "c":
+                        done.set()
+                    return Behaviors.same
+                return Behaviors.receive(on_msg)
+
+            return Behaviors.receive(waiting)
+        return Behaviors.with_stash(100, _factory)
+
+    ref = tsystem.spawn(initializing())
+    for m in ["a", "b", "c"]:
+        ref.tell(m)
+    ref.tell("go")
+    assert done.wait(5.0)
+    assert processed == ["a", "b", "c"]
+
+
+def test_message_adapter(tsystem):
+    got = threading.Event()
+    seen = []
+
+    def backend():
+        return Behaviors.receive(lambda ctx, msg: (msg[1].tell(("raw", msg[0])), Behaviors.same)[1])
+
+    def frontend():
+        def _setup(ctx):
+            be = ctx.spawn(backend(), "backend")
+            adapter = ctx.message_adapter(lambda raw: ("wrapped", raw))
+            be.tell((42, adapter))
+
+            def on_msg(ctx, msg):
+                seen.append(msg)
+                got.set()
+                return Behaviors.same
+            return Behaviors.receive(on_msg)
+        return Behaviors.setup(_setup)
+
+    tsystem.spawn(frontend())
+    assert got.wait(5.0)
+    assert seen == [("wrapped", ("raw", 42))]
