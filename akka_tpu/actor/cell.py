@@ -62,6 +62,7 @@ class ActorCell:
         self._terminating = False
         self._terminated = False
         self._failed_perpetrator: Optional[ActorRef] = None
+        self._failure_cause: Optional[BaseException] = None
         self._pending_recreate_cause: Optional[BaseException] = None
         self._pending_recreate_wait: set = set()
         self.uid = self_ref.path.uid
@@ -241,7 +242,7 @@ class ActorCell:
                 self._handle_failed(message)
             elif isinstance(message, sysmsg.DeathWatchNotification):
                 self._watched_actor_terminated(message.actor, message.existence_confirmed,
-                                               message.address_terminated)
+                                               message.address_terminated, message.cause)
             elif isinstance(message, sysmsg.NoMessage):
                 pass
         except Exception as e:  # noqa: BLE001 — supervision boundary
@@ -284,6 +285,7 @@ class ActorCell:
         if self._failed_perpetrator is not None:
             return
         self._failed_perpetrator = self.self_ref
+        self._failure_cause = cause
         try:
             self.suspend_self_and_children()
             if self.parent is not None:
@@ -320,6 +322,7 @@ class ActorCell:
     def _fault_resume(self, caused_by_failure: Optional[BaseException]) -> None:
         if caused_by_failure is not None:
             self._failed_perpetrator = None
+            self._failure_cause = None
         if self.mailbox.resume():
             for child in self.children:
                 if isinstance(child, InternalActorRef):
@@ -380,6 +383,7 @@ class ActorCell:
 
     def _finish_recreate(self, cause: Optional[BaseException]) -> None:
         self._failed_perpetrator = None
+        self._failure_cause = None
         self._pending_recreate_cause = None
         self._pending_recreate_wait = set()
         try:
@@ -445,14 +449,17 @@ class ActorCell:
                 if isinstance(ref, InternalActorRef):
                     ref.send_system_message(sysmsg.Unwatch(watchee=ref, watcher=self.self_ref))
             self._watching.clear()
-            # notify watchers + parent
+            # notify watchers + parent (cause propagates failure deaths
+            # into typed ChildFailed signals)
             for watcher in list(self._watched_by):
                 watcher.send_system_message(
-                    sysmsg.DeathWatchNotification(self.self_ref, existence_confirmed=True))
+                    sysmsg.DeathWatchNotification(self.self_ref, existence_confirmed=True,
+                                                  cause=self._failure_cause))
             self._watched_by.clear()
             if self.parent is not None:
                 self.parent.send_system_message(
-                    sysmsg.DeathWatchNotification(self.self_ref, existence_confirmed=True))
+                    sysmsg.DeathWatchNotification(self.self_ref, existence_confirmed=True,
+                                                  cause=self._failure_cause))
             self.actor = None
             if self.system.settings.debug_lifecycle:
                 self._log_debug("stopped")
@@ -472,7 +479,8 @@ class ActorCell:
             self._watched_by.discard(watcher)
 
     def _watched_actor_terminated(self, actor: ActorRef, existence_confirmed: bool,
-                                  address_terminated: bool) -> None:
+                                  address_terminated: bool,
+                                  cause: Optional[BaseException] = None) -> None:
         """(reference: dungeon/DeathWatch.watchedActorTerminated :81)"""
         name = actor.path.name
         is_child = self._children.get(name) == actor
@@ -491,7 +499,7 @@ class ActorCell:
             custom = self._watching.pop(actor)
             if not self._terminating and not self._terminated:
                 message = custom if custom is not None else Terminated(
-                    actor, existence_confirmed, address_terminated)
+                    actor, existence_confirmed, address_terminated, cause)
                 # delivered as a normal user message, bypassing the closed check
                 self._invoke_terminated(Envelope(message, actor))
 

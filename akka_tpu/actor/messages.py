@@ -104,10 +104,12 @@ class InvalidMessageException(Exception):
 @dataclass(frozen=True)
 class Terminated(AutoReceivedMessage):
     """DeathWatch notification delivered to watchers
-    (reference: actor/dungeon/DeathWatch.scala:81)."""
+    (reference: actor/dungeon/DeathWatch.scala:81). `cause` is non-None when
+    the watched actor died from a failure (feeds typed ChildFailed)."""
     actor: Any
     existence_confirmed: bool = True
     address_terminated: bool = False
+    cause: Optional[BaseException] = None
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,106 @@
+"""Batched behaviors: vmapped per-actor update functions.
+
+This is the TPU-native replacement for the reference's receive loop
+(dispatch/Mailbox.scala:260-277 processMailbox + actor/ActorCell.scala:539-555
+invoke): instead of dequeue-and-call per actor on a thread pool, every live
+actor's update runs as ONE vmapped, jitted function per step, selected by
+behavior id via lax.switch (the tensorized analogue of the typed interpreter's
+tag switch, typed/Behavior.scala:244-278).
+
+A BatchedBehavior declares:
+- a fixed per-actor state schema (SoA columns),
+- `receive_batch(state_row, inbox, ctx) -> (new_state_row, Emit)` written in
+  scalar JAX (it will be vmapped), where `inbox` carries the segment-reduced
+  payload sum/max and message count for this actor this step.
+
+Message delivery is commutative-reduction (segment_sum over recipient ids) —
+the GNN-style message passing of the BASELINE north star. Per-sender FIFO
+ordering within a step is preserved by construction (each actor emits at most
+`out_degree` messages per step; reductions are order-insensitive).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Inbox(NamedTuple):
+    """What a single actor sees from one step's delivery (all per-actor slices,
+    scalar/vector shaped — the runtime vmaps over actors)."""
+
+    sum: jax.Array    # [P] segment-sum of payloads addressed to this actor
+    max: jax.Array    # [P] segment-max (useful for latched signals / LWW)
+    count: jax.Array  # [] int32 number of messages delivered
+
+
+class Emit(NamedTuple):
+    """Up to K outgoing messages from one actor in one step."""
+
+    dst: jax.Array      # [K] int32 recipient ids (global); -1 = none
+    payload: jax.Array  # [K, P]
+    valid: jax.Array    # [K] bool
+
+    @staticmethod
+    def none(out_degree: int, payload_width: int, dtype=jnp.float32) -> "Emit":
+        return Emit(
+            dst=jnp.full((out_degree,), -1, dtype=jnp.int32),
+            payload=jnp.zeros((out_degree, payload_width), dtype=dtype),
+            valid=jnp.zeros((out_degree,), dtype=jnp.bool_),
+        )
+
+    @staticmethod
+    def single(dst, payload, out_degree: int, payload_width: int,
+               when=True, dtype=jnp.float32) -> "Emit":
+        """One message in slot 0, rest empty. `when` may be a traced bool."""
+        e = Emit.none(out_degree, payload_width, dtype)
+        pl = jnp.asarray(payload, dtype=dtype).reshape(-1)
+        pl = jnp.pad(pl, (0, payload_width - pl.shape[0]))
+        cond = jnp.asarray(when, dtype=jnp.bool_)
+        return Emit(
+            dst=e.dst.at[0].set(jnp.where(cond, jnp.asarray(dst, jnp.int32), -1)),
+            payload=e.payload.at[0].set(pl),
+            valid=e.valid.at[0].set(cond),
+        )
+
+
+class Ctx(NamedTuple):
+    """Per-actor step context."""
+
+    actor_id: jax.Array  # [] int32 — this actor's global id
+    step: jax.Array      # [] int32 — global step counter
+    n_actors: jax.Array  # [] int32 — capacity of the actor space
+
+
+@dataclass
+class BatchedBehavior:
+    """The batched analogue of Behavior[T].
+
+    `receive` signature: (state: dict[str, Array-per-actor-slice], inbox: Inbox,
+    ctx: Ctx) -> (new_state, Emit). Runs only for actors whose `count > 0`
+    unless `always_on` (sources tick every step).
+    """
+
+    name: str
+    state_spec: Dict[str, Tuple[Tuple[int, ...], Any]]  # col -> (shape, dtype)
+    receive: Callable[[Dict[str, jax.Array], Inbox, Ctx], Tuple[Dict[str, jax.Array], Emit]]
+    always_on: bool = False
+
+    def init_state(self, n: int) -> Dict[str, jax.Array]:
+        return {k: jnp.zeros((n,) + tuple(shape), dtype=dtype)
+                for k, (shape, dtype) in self.state_spec.items()}
+
+
+def behavior(name: str, state_spec: Dict[str, Tuple[Tuple[int, ...], Any]],
+             always_on: bool = False):
+    """Decorator: @behavior("counter", {"count": ((), jnp.int32)})"""
+
+    def deco(fn) -> BatchedBehavior:
+        return BatchedBehavior(name=name, state_spec=state_spec, receive=fn,
+                               always_on=always_on)
+
+    return deco

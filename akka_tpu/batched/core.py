@@ -1,0 +1,302 @@
+"""BatchedSystem: the SoA device runtime — millions of actors per chip.
+
+This is the `tpu-batched` Dispatcher/Mailbox of the BASELINE north star. The
+mapping from the reference's hot path (SURVEY.md §3.2):
+
+  reference                                   here
+  ---------                                   ----
+  ActorRef.! -> mailbox.enqueue               tell() -> host staging buffer, or
+    (dispatch/Dispatcher.scala:61-65)           on-device Emit from a behavior
+  registerForExecution CAS + thread pool      the step loop itself (jit)
+    (dispatch/Dispatcher.scala:120-143)
+  Mailbox.processMailbox dequeue loop         segment-sum delivery (ops/segment.py)
+    (dispatch/Mailbox.scala:260-277)
+  ActorCell.invoke -> receive                 vmapped behavior switch
+    (actor/ActorCell.scala:539-555)             (lax.switch over behavior ids)
+
+State is a dict of [capacity, ...] columns (union of all behavior schemas);
+messages are (dst, payload, valid) SoA blocks; one `step` delivers every
+in-flight message and runs every live actor's update, entirely on device.
+`run(n)` lax.scans the step so multi-step benches never touch the host.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.segment import Delivery, deliver
+from .behavior import BatchedBehavior, Ctx, Emit, Inbox
+
+
+class BatchedSystem:
+    """Single-device (or single-shard) batched actor space.
+
+    capacity: max live actors (rows); out_degree K: max emissions per actor per
+    step; payload_width P: message payload columns; host_inbox: slots reserved
+    for host-injected tells per flush.
+    """
+
+    def __init__(self, capacity: int, behaviors: Sequence[BatchedBehavior],
+                 payload_width: int = 4, out_degree: int = 1,
+                 host_inbox: int = 1024, payload_dtype=jnp.float32,
+                 device: Optional[Any] = None, delivery: str = "sort",
+                 need_max: bool = False, topology=None):
+        if not behaviors:
+            raise ValueError("at least one behavior required")
+        self.capacity = int(capacity)
+        self.behaviors = list(behaviors)
+        self.payload_width = int(payload_width)
+        self.out_degree = int(out_degree)
+        self.host_inbox = int(host_inbox)
+        self.payload_dtype = payload_dtype
+        self.device = device
+        self.delivery = delivery
+        self.need_max = need_max
+        self.topology = topology  # ops.segment.StaticTopology | None
+
+        # unified state schema (union of behavior columns; conflicting specs are errors)
+        self.state_spec: Dict[str, Tuple[Tuple[int, ...], Any]] = {}
+        for b in self.behaviors:
+            for col, spec in b.state_spec.items():
+                if col in self.state_spec and self.state_spec[col] != spec:
+                    raise ValueError(
+                        f"behavior {b.name}: state column {col!r} conflicts "
+                        f"({self.state_spec[col]} vs {spec})")
+                self.state_spec[col] = ((tuple(spec[0])), spec[1])
+
+        n = self.capacity
+        self.state: Dict[str, jax.Array] = {
+            k: jnp.zeros((n,) + shape, dtype=dtype)
+            for k, (shape, dtype) in self.state_spec.items()}
+        self.behavior_id = jnp.zeros((n,), dtype=jnp.int32)
+        self.alive = jnp.zeros((n,), dtype=jnp.bool_)
+        self.step_count = jnp.asarray(0, jnp.int32)
+
+        m = n * self.out_degree + self.host_inbox
+        self.inbox_dst = jnp.full((m,), -1, dtype=jnp.int32)
+        self.inbox_payload = jnp.zeros((m, self.payload_width), dtype=payload_dtype)
+        self.inbox_valid = jnp.zeros((m,), dtype=jnp.bool_)
+
+        self._next_row = 0
+        self._free_rows: List[int] = []
+        self._host_staged: List[Tuple[int, np.ndarray]] = []
+        self._lock = threading.Lock()
+        self.dropped_messages = 0
+
+        # topology tables ride as runtime arguments (pytree): closure
+        # constants would be baked into the HLO (multi-MB programs break
+        # remote compile). Kind/scalars are trace-time constants.
+        self._topo_arrays = topology.runtime_arrays() if topology is not None else ()
+        self._step_jit = jax.jit(self._step_impl, donate_argnums=(0, 1, 2, 3, 4, 5))
+        self._run_jit = jax.jit(self._run_impl, static_argnums=(8,),
+                                donate_argnums=(0, 1, 2, 3, 4, 5))
+
+    # ------------------------------------------------------------- lifecycle
+    def spawn_block(self, behavior: BatchedBehavior | int, n: int,
+                    init_state: Optional[Dict[str, Any]] = None) -> np.ndarray:
+        """Allocate a contiguous block of n actors with the given behavior.
+        Host-side slow path, mirroring the reference's spawn being off the
+        message hot loop. Returns the global ids."""
+        b_idx = behavior if isinstance(behavior, int) else self.behaviors.index(behavior)
+        with self._lock:
+            start = self._next_row
+            if start + n > self.capacity:
+                raise RuntimeError(
+                    f"actor capacity exhausted ({start}+{n} > {self.capacity})")
+            self._next_row = start + n
+        ids = np.arange(start, start + n, dtype=np.int32)
+        sl = slice(start, start + n)
+        self.behavior_id = self.behavior_id.at[sl].set(b_idx)
+        self.alive = self.alive.at[sl].set(True)
+        if init_state:
+            for col, value in init_state.items():
+                if col not in self.state:
+                    raise KeyError(f"unknown state column {col!r}")
+                self.state[col] = self.state[col].at[sl].set(
+                    jnp.asarray(value, dtype=self.state[col].dtype))
+        return ids
+
+    def stop_block(self, ids: np.ndarray) -> None:
+        """Mark actors dead (their rows stop updating and emitting)."""
+        self.alive = self.alive.at[jnp.asarray(ids)].set(False)
+
+    # ------------------------------------------------------------------ tell
+    def tell(self, dst, payload) -> None:
+        """Host-side tell: staged, flushed into the inbox on next step.
+        dst: int or [k] array; payload: [P] or [k, P]."""
+        dst_arr = np.atleast_1d(np.asarray(dst, dtype=np.int32))
+        pl = np.asarray(payload, dtype=jnp.dtype(self.payload_dtype))
+        if pl.ndim == 1 and dst_arr.shape[0] == 1:
+            pl = pl[None, :]
+        if pl.shape[-1] != self.payload_width:
+            pad = self.payload_width - pl.shape[-1]
+            if pad < 0:
+                raise ValueError(f"payload wider than {self.payload_width}")
+            pl = np.pad(pl, [(0, 0)] * (pl.ndim - 1) + [(0, pad)])
+        with self._lock:
+            for d, p in zip(dst_arr, pl):
+                self._host_staged.append((int(d), p))
+
+    def seed_inbox(self, dst, payload) -> None:
+        """Bulk device-side injection: overwrite the first len(dst) inbox slots
+        (the fast path for benches / bulk tells — the equivalent of the
+        reference bench pre-filling mailboxes, TellOnlyBenchmark.scala:19-92)."""
+        dst = jnp.asarray(dst, jnp.int32)
+        payload = jnp.asarray(payload, self.payload_dtype)
+        if payload.ndim == 1:
+            payload = jnp.broadcast_to(payload[None, :], (dst.shape[0], self.payload_width))
+        k = dst.shape[0]
+        if k > self.inbox_dst.shape[0]:
+            raise ValueError("seed exceeds inbox capacity")
+        self.inbox_dst = self.inbox_dst.at[:k].set(dst)
+        self.inbox_payload = self.inbox_payload.at[:k].set(payload)
+        self.inbox_valid = self.inbox_valid.at[:k].set(True)
+
+    def _flush_staged(self) -> None:
+        with self._lock:
+            staged, self._host_staged = self._host_staged, []
+        if not staged:
+            return
+        if len(staged) > self.host_inbox:
+            self.dropped_messages += len(staged) - self.host_inbox
+            staged = staged[: self.host_inbox]
+        base = self.capacity * self.out_degree
+        idx = jnp.arange(base, base + len(staged))
+        dsts = jnp.asarray([d for d, _ in staged], dtype=jnp.int32)
+        pls = jnp.asarray(np.stack([p for _, p in staged]), dtype=self.payload_dtype)
+        self.inbox_dst = self.inbox_dst.at[idx].set(dsts)
+        self.inbox_payload = self.inbox_payload.at[idx].set(pls)
+        self.inbox_valid = self.inbox_valid.at[idx].set(True)
+
+    # ------------------------------------------------------------------ step
+    def _make_branches(self):
+        n, k_out, p_w = self.capacity, self.out_degree, self.payload_width
+
+        def wrap(b: BatchedBehavior):
+            def branch(state_row, inbox: Inbox, ctx: Ctx):
+                new_cols, emit = b.receive(dict(state_row), inbox, ctx)
+                merged = dict(state_row)
+                merged.update(new_cols)
+                # gate: actors with no input skip unless always_on
+                active = (inbox.count > 0) | jnp.asarray(b.always_on)
+                merged = jax.tree.map(
+                    lambda new, old: jnp.where(
+                        jnp.reshape(active, (1,) * 0 + tuple([1] * new.ndim))
+                        if new.ndim else active, new, old),
+                    merged, dict(state_row))
+                emit = Emit(dst=jnp.where(active, emit.dst, -1),
+                            payload=emit.payload,
+                            valid=emit.valid & active)
+                return merged, emit
+            return branch
+
+        return [wrap(b) for b in self.behaviors]
+
+    def _step_impl(self, state, behavior_id, alive, inbox_dst, inbox_payload,
+                   inbox_valid, step_count, topo_arrays=()):
+        n = self.capacity
+        nk = n * self.out_degree
+        if self.topology is not None:
+            # static-topology fast path: compiled routing (shift/mod/block/
+            # dense/csr — see ops.segment.StaticTopology)
+            from ..ops.segment import deliver_static
+            d: Delivery = deliver_static(self.topology, topo_arrays,
+                                         inbox_payload[:nk],
+                                         inbox_valid[:nk], self.need_max)
+            if self.host_inbox > 0:
+                hd = deliver(inbox_dst[nk:], inbox_payload[nk:],
+                             inbox_valid[nk:], n, self.need_max, mode="sort")
+                d = Delivery(sum=d.sum + hd.sum,
+                             max=jnp.maximum(d.max, hd.max),
+                             count=d.count + hd.count)
+        else:
+            d = deliver(inbox_dst, inbox_payload, inbox_valid, n,
+                        self.need_max, mode=self.delivery)
+        branches = self._make_branches()
+        ctx_ids = jnp.arange(n, dtype=jnp.int32)
+
+        def per_actor(state_row, b_id, sum_i, max_i, count_i, alive_i, idx):
+            inbox = Inbox(sum=sum_i, max=max_i, count=count_i)
+            ctx = Ctx(actor_id=idx, step=step_count, n_actors=jnp.asarray(n, jnp.int32))
+            new_state, emit = jax.lax.switch(b_id, branches, state_row, inbox, ctx)
+            # dead actors never update or emit
+            new_state = jax.tree.map(
+                lambda new, old: jnp.where(
+                    jnp.reshape(alive_i, tuple([1] * new.ndim)) if new.ndim else alive_i,
+                    new, old),
+                new_state, state_row)
+            emit = Emit(dst=jnp.where(alive_i, emit.dst, -1),
+                        payload=emit.payload,
+                        valid=emit.valid & alive_i)
+            return new_state, emit
+
+        new_state, emits = jax.vmap(per_actor)(
+            state, behavior_id, d.sum, d.max, d.count, alive, ctx_ids)
+
+        m = n * self.out_degree + self.host_inbox
+        out_dst = emits.dst.reshape(-1)
+        out_payload = emits.payload.reshape(-1, self.payload_width)
+        out_valid = emits.valid.reshape(-1)
+        new_inbox_dst = jnp.concatenate(
+            [out_dst, jnp.full((self.host_inbox,), -1, jnp.int32)])
+        new_inbox_payload = jnp.concatenate(
+            [out_payload, jnp.zeros((self.host_inbox, self.payload_width),
+                                    self.payload_dtype)])
+        new_inbox_valid = jnp.concatenate(
+            [out_valid, jnp.zeros((self.host_inbox,), jnp.bool_)])
+        return (new_state, behavior_id, alive, new_inbox_dst, new_inbox_payload,
+                new_inbox_valid, step_count + 1)
+
+    def _run_impl(self, state, behavior_id, alive, inbox_dst, inbox_payload,
+                  inbox_valid, step_count, topo_arrays, n_steps: int):
+        def body(carry, _):
+            return self._step_impl(*carry, topo_arrays), None
+
+        carry = (state, behavior_id, alive, inbox_dst, inbox_payload,
+                 inbox_valid, step_count)
+        carry, _ = jax.lax.scan(body, carry, None, length=n_steps)
+        return carry
+
+    def step(self) -> None:
+        """One delivery+update step (flushes host tells first)."""
+        self._flush_staged()
+        (self.state, self.behavior_id, self.alive, self.inbox_dst,
+         self.inbox_payload, self.inbox_valid, self.step_count) = self._step_jit(
+            self.state, self.behavior_id, self.alive, self.inbox_dst,
+            self.inbox_payload, self.inbox_valid, self.step_count,
+            self._topo_arrays)
+
+    def run(self, n_steps: int) -> None:
+        """n steps fully on device (lax.scan) — the bench hot loop."""
+        self._flush_staged()
+        (self.state, self.behavior_id, self.alive, self.inbox_dst,
+         self.inbox_payload, self.inbox_valid, self.step_count) = self._run_jit(
+            self.state, self.behavior_id, self.alive, self.inbox_dst,
+            self.inbox_payload, self.inbox_valid, self.step_count,
+            self._topo_arrays, n_steps)
+
+    def block_until_ready(self) -> None:
+        # sync via a host read of a non-donated output: on some platforms
+        # donated/aliased buffers report ready before the program finishes
+        np.asarray(jax.device_get(self.step_count))
+
+    # ------------------------------------------------------------------ read
+    def read_state(self, col: str, ids: Optional[np.ndarray] = None) -> np.ndarray:
+        arr = self.state[col]
+        if ids is not None:
+            arr = arr[jnp.asarray(ids)]
+        return np.asarray(jax.device_get(arr))
+
+    @property
+    def live_count(self) -> int:
+        return int(jnp.sum(self.alive.astype(jnp.int32)))
+
+    @property
+    def pending_messages(self) -> int:
+        return int(jnp.sum(self.inbox_valid.astype(jnp.int32)))

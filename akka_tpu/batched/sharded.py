@@ -1,0 +1,289 @@
+"""ShardedBatchedSystem: the actor space sharded over a device mesh.
+
+This is the TPU-native analogue of cluster sharding's data plane
+(sharding/ShardRegion.scala:1046 deliverMessage — resolve shard, forward) plus
+Artery's transport (SURVEY.md §2.3): entities→shards→regions becomes
+actors→shard-axis→devices, and a cross-shard tell becomes a slot in the
+all_to_all exchange buffer inside the jitted step — messages ride ICI, never
+the host.
+
+Routing inside shard_map, per step:
+1. deliver the local inbox (segment-sum over local recipient ids),
+2. run the vmapped behavior switch (global actor ids),
+3. bucket emitted messages by destination shard (stable sort → rank-in-group
+   → scatter into a [D, C] exchange buffer; overflow drops are counted),
+4. `lax.all_to_all` the buffer — each shard receives its [D, C] slice, which
+   becomes the next step's inbox (self-addressed chunks deliver locally).
+
+Per-pair capacity C defaults to lossless (all local emissions could target
+one shard). Static shapes throughout; the whole step is one jitted program.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..ops.segment import Delivery, deliver
+from ..parallel.mesh import make_mesh
+from .behavior import BatchedBehavior, Ctx, Emit, Inbox
+
+
+class ShardedBatchedSystem:
+    def __init__(self, capacity: int, behaviors: Sequence[BatchedBehavior],
+                 mesh: Optional[Mesh] = None, n_devices: Optional[int] = None,
+                 payload_width: int = 4, out_degree: int = 1,
+                 host_inbox_per_shard: int = 256,
+                 remote_capacity_per_pair: Optional[int] = None,
+                 payload_dtype=jnp.float32, axis_name: str = "shards"):
+        self.mesh = mesh if mesh is not None else make_mesh(n_devices, axis_name)
+        self.axis = axis_name
+        self.n_shards = self.mesh.shape[axis_name]
+        if capacity % self.n_shards != 0:
+            capacity += self.n_shards - capacity % self.n_shards
+        self.capacity = capacity
+        self.local_n = capacity // self.n_shards
+        self.behaviors = list(behaviors)
+        self.payload_width = payload_width
+        self.out_degree = out_degree
+        self.host_inbox = host_inbox_per_shard
+        self.payload_dtype = payload_dtype
+        # lossless default: every local emission could target a single shard
+        self.pair_cap = (remote_capacity_per_pair if remote_capacity_per_pair
+                         else self.local_n * out_degree)
+
+        self.state_spec: Dict[str, Tuple[Tuple[int, ...], Any]] = {}
+        for b in self.behaviors:
+            for col, spec in b.state_spec.items():
+                if col in self.state_spec and self.state_spec[col] != spec:
+                    raise ValueError(f"conflicting column {col!r}")
+                self.state_spec[col] = (tuple(spec[0]), spec[1])
+
+        shard = NamedSharding(self.mesh, P(axis_name))
+        n = self.capacity
+        self.state = {k: jax.device_put(jnp.zeros((n,) + shape, dtype=dtype), shard)
+                      for k, (shape, dtype) in self.state_spec.items()}
+        self.behavior_id = jax.device_put(jnp.zeros((n,), jnp.int32), shard)
+        self.alive = jax.device_put(jnp.zeros((n,), jnp.bool_), shard)
+        self.step_count = jnp.asarray(0, jnp.int32)
+
+        # inbox per shard: D*C exchange slots + host slots
+        self.m_local = self.n_shards * self.pair_cap + self.host_inbox
+        m_global = self.m_local * self.n_shards
+        self.inbox_dst = jax.device_put(jnp.full((m_global,), -1, jnp.int32), shard)
+        self.inbox_payload = jax.device_put(
+            jnp.zeros((m_global, payload_width), payload_dtype), shard)
+        self.inbox_valid = jax.device_put(jnp.zeros((m_global,), jnp.bool_), shard)
+        self.dropped = jax.device_put(jnp.zeros((self.n_shards,), jnp.int32), shard)
+
+        self._next_row = 0
+        self._lock = threading.Lock()
+        self._host_staged: List[Tuple[int, np.ndarray]] = []
+
+        self._step_fn = self._build_step()
+
+    # -------------------------------------------------------------- builders
+    def _build_step(self):
+        n_local, n_shards, k_out = self.local_n, self.n_shards, self.out_degree
+        p_w, dtype = self.payload_width, self.payload_dtype
+        pair_cap, m_local, axis = self.pair_cap, self.m_local, self.axis
+        n_global = self.capacity
+        behaviors = self.behaviors
+
+        def wrap(b: BatchedBehavior):
+            def branch(state_row, inbox: Inbox, ctx: Ctx):
+                new_cols, emit = b.receive(dict(state_row), inbox, ctx)
+                merged = dict(state_row)
+                merged.update(new_cols)
+                active = (inbox.count > 0) | jnp.asarray(b.always_on)
+                merged = jax.tree.map(
+                    lambda new, old: jnp.where(
+                        jnp.reshape(active, tuple([1] * new.ndim)) if new.ndim else active,
+                        new, old),
+                    merged, dict(state_row))
+                return merged, Emit(dst=jnp.where(active, emit.dst, -1),
+                                    payload=emit.payload,
+                                    valid=emit.valid & active)
+            return branch
+
+        branches = [wrap(b) for b in behaviors]
+
+        def local_step(state, behavior_id, alive, inbox_dst, inbox_payload,
+                       inbox_valid, dropped, step_count):
+            # shapes here are per-shard blocks
+            shard_idx = jax.lax.axis_index(axis)
+            base = shard_idx * n_local
+
+            local_dst = inbox_dst - base  # global -> local
+            d: Delivery = deliver(local_dst, inbox_payload, inbox_valid, n_local)
+
+            ids = base + jnp.arange(n_local, dtype=jnp.int32)
+
+            def per_actor(state_row, b_id, sum_i, max_i, count_i, alive_i, gid):
+                inbox = Inbox(sum=sum_i, max=max_i, count=count_i)
+                ctx = Ctx(actor_id=gid, step=step_count,
+                          n_actors=jnp.asarray(n_global, jnp.int32))
+                new_state, emit = jax.lax.switch(b_id, branches, state_row, inbox, ctx)
+                new_state = jax.tree.map(
+                    lambda new, old: jnp.where(
+                        jnp.reshape(alive_i, tuple([1] * new.ndim)) if new.ndim else alive_i,
+                        new, old),
+                    new_state, state_row)
+                return new_state, Emit(dst=jnp.where(alive_i, emit.dst, -1),
+                                       payload=emit.payload,
+                                       valid=emit.valid & alive_i)
+
+            new_state, emits = jax.vmap(per_actor)(
+                state, behavior_id, d.sum, d.max, d.count, alive, ids)
+
+            # ---- route: bucket by destination shard, exchange over ICI ----
+            out_dst = emits.dst.reshape(-1)                       # [n_local*k]
+            out_payload = emits.payload.reshape(-1, p_w)
+            out_valid = emits.valid.reshape(-1) & (out_dst >= 0) & (out_dst < n_global)
+            dest_shard = jnp.where(out_valid, out_dst // n_local, n_shards)
+
+            order = jnp.argsort(dest_shard, stable=True)
+            ds_sorted = dest_shard[order]
+            dst_sorted = out_dst[order]
+            pl_sorted = out_payload[order]
+            ok_sorted = out_valid[order]
+            group_start = jnp.searchsorted(ds_sorted, jnp.arange(n_shards + 1))
+            rank = jnp.arange(ds_sorted.shape[0]) - group_start[ds_sorted]
+            in_cap = ok_sorted & (rank < pair_cap) & (ds_sorted < n_shards)
+            slot = jnp.where(in_cap, ds_sorted * pair_cap + rank,
+                             n_shards * pair_cap)  # overflow bucket
+            n_dropped = jnp.sum((ok_sorted & ~in_cap).astype(jnp.int32))
+
+            buf_dst = jnp.full((n_shards * pair_cap + 1,), -1, jnp.int32)
+            buf_pl = jnp.zeros((n_shards * pair_cap + 1, p_w), dtype)
+            buf_ok = jnp.zeros((n_shards * pair_cap + 1,), jnp.bool_)
+            buf_dst = buf_dst.at[slot].set(jnp.where(in_cap, dst_sorted, -1))
+            buf_pl = buf_pl.at[slot].set(jnp.where(in_cap[:, None], pl_sorted, 0))
+            buf_ok = buf_ok.at[slot].set(in_cap)
+            buf_dst, buf_pl, buf_ok = buf_dst[:-1], buf_pl[:-1], buf_ok[:-1]
+
+            # all_to_all: chunk d of my buffer -> shard d; I receive chunk-for-me
+            # from every shard (self chunk included -> local messages loop back)
+            recv_dst = jax.lax.all_to_all(
+                buf_dst.reshape(n_shards, pair_cap), axis, 0, 0, tiled=False).reshape(-1)
+            recv_pl = jax.lax.all_to_all(
+                buf_pl.reshape(n_shards, pair_cap, p_w), axis, 0, 0, tiled=False
+            ).reshape(-1, p_w)
+            recv_ok = jax.lax.all_to_all(
+                buf_ok.reshape(n_shards, pair_cap), axis, 0, 0, tiled=False).reshape(-1)
+
+            new_inbox_dst = jnp.concatenate(
+                [recv_dst, jnp.full((m_local - recv_dst.shape[0],), -1, jnp.int32)])
+            new_inbox_payload = jnp.concatenate(
+                [recv_pl, jnp.zeros((m_local - recv_pl.shape[0], p_w), dtype)])
+            new_inbox_valid = jnp.concatenate(
+                [recv_ok, jnp.zeros((m_local - recv_ok.shape[0],), jnp.bool_)])
+            new_dropped = dropped + n_dropped
+
+            return (new_state, behavior_id, alive, new_inbox_dst,
+                    new_inbox_payload, new_inbox_valid, new_dropped, step_count + 1)
+
+        mesh = self.mesh
+        state_specs = {k: P(axis) for k in self.state_spec}
+        in_specs = (state_specs, P(axis), P(axis), P(axis), P(axis), P(axis),
+                    P(axis), P())
+        out_specs = (state_specs, P(axis), P(axis), P(axis), P(axis), P(axis),
+                     P(axis), P())
+
+        sharded = shard_map(local_step, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs, check_vma=False)
+
+        def multi_step(state, behavior_id, alive, inbox_dst, inbox_payload,
+                       inbox_valid, dropped, step_count, n_steps: int):
+            def body(carry, _):
+                return sharded(*carry), None
+            carry = (state, behavior_id, alive, inbox_dst, inbox_payload,
+                     inbox_valid, dropped, step_count)
+            carry, _ = jax.lax.scan(body, carry, None, length=n_steps)
+            return carry
+
+        return jax.jit(multi_step, static_argnums=(8,),
+                       donate_argnums=(0, 1, 2, 3, 4, 5, 6))
+
+    # ------------------------------------------------------------- lifecycle
+    def spawn_block(self, behavior: BatchedBehavior | int, n: int,
+                    init_state: Optional[Dict[str, Any]] = None) -> np.ndarray:
+        b_idx = behavior if isinstance(behavior, int) else self.behaviors.index(behavior)
+        with self._lock:
+            start = self._next_row
+            if start + n > self.capacity:
+                raise RuntimeError("actor capacity exhausted")
+            self._next_row = start + n
+        sl = slice(start, start + n)
+        self.behavior_id = self.behavior_id.at[sl].set(b_idx)
+        self.alive = self.alive.at[sl].set(True)
+        if init_state:
+            for col, value in init_state.items():
+                self.state[col] = self.state[col].at[sl].set(
+                    jnp.asarray(value, dtype=self.state[col].dtype))
+        return np.arange(start, start + n, dtype=np.int32)
+
+    def tell(self, dst: int, payload) -> None:
+        pl = np.zeros(self.payload_width, dtype=jnp.dtype(self.payload_dtype))
+        arr = np.asarray(payload).reshape(-1)
+        pl[: arr.shape[0]] = arr
+        with self._lock:
+            self._host_staged.append((int(dst), pl))
+
+    def _flush_staged(self) -> None:
+        with self._lock:
+            staged, self._host_staged = self._host_staged, []
+        if not staged:
+            return
+        # host slots live at the tail of each shard's inbox block; place each
+        # message in its destination shard's host region
+        per_shard_used: Dict[int, int] = {}
+        idxs, dsts, pls = [], [], []
+        for d, p in staged:
+            s = d // self.local_n
+            u = per_shard_used.get(s, 0)
+            if u >= self.host_inbox:
+                continue
+            per_shard_used[s] = u + 1
+            idxs.append(s * self.m_local + self.n_shards * self.pair_cap + u)
+            dsts.append(d)
+            pls.append(p)
+        if not idxs:
+            return
+        idx = jnp.asarray(idxs)
+        self.inbox_dst = self.inbox_dst.at[idx].set(jnp.asarray(dsts, jnp.int32))
+        self.inbox_payload = self.inbox_payload.at[idx].set(
+            jnp.asarray(np.stack(pls), self.payload_dtype))
+        self.inbox_valid = self.inbox_valid.at[idx].set(True)
+
+    # ------------------------------------------------------------------ step
+    def run(self, n_steps: int = 1) -> None:
+        self._flush_staged()
+        (self.state, self.behavior_id, self.alive, self.inbox_dst,
+         self.inbox_payload, self.inbox_valid, self.dropped, self.step_count) = \
+            self._step_fn(self.state, self.behavior_id, self.alive,
+                          self.inbox_dst, self.inbox_payload, self.inbox_valid,
+                          self.dropped, self.step_count, n_steps)
+
+    step = run
+
+    def read_state(self, col: str, ids: Optional[np.ndarray] = None) -> np.ndarray:
+        arr = self.state[col]
+        if ids is not None:
+            arr = arr[jnp.asarray(ids)]
+        return np.asarray(jax.device_get(arr))
+
+    @property
+    def total_dropped(self) -> int:
+        return int(jnp.sum(self.dropped))
+
+    def block_until_ready(self) -> None:
+        # sync via host read of a non-donated output (see core.py note)
+        np.asarray(jax.device_get(self.step_count))

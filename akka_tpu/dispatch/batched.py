@@ -1,0 +1,75 @@
+"""The `tpu-batched` dispatcher type — the BASELINE north-star seam.
+
+Reference parity: the MessageDispatcherConfigurator / Dispatchers extension
+point (dispatch/Dispatchers.scala:235-259, registerConfigurator :184-185)
+gates the backend, so `akka.actor.default-dispatcher.type = tpu-batched` (or a
+dedicated `akka.actor.tpu-dispatcher` id) selects this dispatcher.
+
+Semantics: ordinary Python actors attached to this dispatcher still execute on
+a host thread pool (they are the control plane / IO edge), but the dispatcher
+owns a device-resident BatchedSystem; actors whose Props carry a
+BatchedBehavior are laid out as rows in the SoA slabs and stepped on-device.
+`BatchedRuntimeHandle.tell` bridges host refs into the device inbox (the
+slow-lane equivalent of Artery's large-message lane)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from .dispatcher import Dispatcher, DispatcherConfigurator
+
+
+class TpuBatchedDispatcher(Dispatcher):
+    """Host-facing dispatcher + owner of the device BatchedSystem."""
+
+    def __init__(self, dispatchers, id: str, config):
+        super().__init__(dispatchers, id,
+                         throughput=config.get_int("throughput", 64),
+                         shutdown_timeout=config.get_duration("shutdown-timeout", "1s"))
+        self._config = config
+        self._runtime = None
+        self._runtime_lock = threading.Lock()
+
+    def runtime(self, behaviors=None, **overrides):
+        """Get (or lazily build) the BatchedSystem for this dispatcher.
+        First caller supplies the behavior list; later callers share it."""
+        with self._runtime_lock:
+            if self._runtime is None:
+                if behaviors is None:
+                    raise ValueError(
+                        "tpu-batched runtime not initialized: first call must "
+                        "pass behaviors=[BatchedBehavior, ...]")
+                from ..batched.core import BatchedSystem
+                c = self._config
+                self._runtime = BatchedSystem(
+                    capacity=overrides.get("capacity", c.get_int("capacity", 1 << 20)),
+                    behaviors=behaviors,
+                    payload_width=overrides.get("payload_width", c.get_int("payload-width", 8)),
+                    out_degree=overrides.get("out_degree", c.get_int("out-degree", 1)),
+                    host_inbox=overrides.get("host_inbox", c.get_int("host-inbox", 1024)),
+                )
+            return self._runtime
+
+    @property
+    def has_runtime(self) -> bool:
+        return self._runtime is not None
+
+
+class TpuBatchedDispatcherConfigurator(DispatcherConfigurator):
+    def __init__(self, config, dispatchers, id: str):
+        super().__init__(config, dispatchers)
+        self.id = id
+        self._instance: Optional[TpuBatchedDispatcher] = None
+        self._lock = threading.Lock()
+
+    def dispatcher(self) -> TpuBatchedDispatcher:
+        with self._lock:
+            if self._instance is None:
+                self._instance = TpuBatchedDispatcher(self.dispatchers, self.id, self.config)
+            return self._instance
+
+
+def register_tpu_dispatcher_type(dispatchers) -> None:
+    """Called from ActorSystem bootstrap (actor/system.py)."""
+    dispatchers.register_type("tpu-batched", TpuBatchedDispatcherConfigurator)

@@ -76,6 +76,7 @@ class DeathWatchNotification(SystemMessage):
     actor: Any = None
     existence_confirmed: bool = True
     address_terminated: bool = False
+    cause: Optional[BaseException] = None  # set when death was a failure
 
 
 @dataclass

@@ -1,0 +1,124 @@
+"""The BASELINE.json bench topologies as batched-behavior 'models'.
+
+These mirror akka-bench-jmh's harnesses (SURVEY.md §6):
+- ring:      1M-actor ring, every actor holds one token and forwards to the
+             next each step (the ForkJoinActorBenchmark ping-pong generalized)
+- fan_in:    1M leaves -> 1k collectors (the segment_sum hot path)
+- ping_pong: 2-actor TellOnlyBenchmark equivalent
+- router:    RoundRobinPool-style index-map routing, 100k routees
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..batched import BatchedSystem, Ctx, Emit, Inbox, behavior
+from ..batched.sharded import ShardedBatchedSystem
+
+PAYLOAD_W = 4
+
+
+@behavior("ring", {"received": ((), jnp.int32)})
+def ring_behavior(state, inbox, ctx):
+    nxt = (ctx.actor_id + 1) % ctx.n_actors
+    return ({"received": state["received"] + inbox.count},
+            Emit.single(nxt, inbox.sum, 1, PAYLOAD_W, when=inbox.count > 0))
+
+
+@behavior("leaf", {}, always_on=True)
+def fan_in_leaf(state, inbox, ctx):
+    # leaves 1..N target collectors 0..(n_collectors-1) by id hash
+    n_collectors = 1000
+    dst = ctx.actor_id % n_collectors
+    return {}, Emit.single(dst, jnp.array([1.0, 0, 0, 0]), 1, PAYLOAD_W,
+                           when=ctx.actor_id >= n_collectors)
+
+
+@behavior("collector", {"total": ((), jnp.float32), "msgs": ((), jnp.int32)})
+def fan_in_collector(state, inbox, ctx):
+    return ({"total": state["total"] + inbox.sum[0],
+             "msgs": state["msgs"] + inbox.count}, Emit.none(1, PAYLOAD_W))
+
+
+def build_ring(n: int = 1 << 20, sharded: bool = False, n_devices=None,
+               static: bool = True):
+    if sharded:
+        sys = ShardedBatchedSystem(capacity=n, behaviors=[ring_behavior],
+                                   n_devices=n_devices, payload_width=PAYLOAD_W,
+                                   host_inbox_per_shard=8)
+    else:
+        topo = None
+        if static:
+            # the ring's wiring is fixed -> compile delivery to a gather
+            from akka_tpu.ops.segment import StaticTopology
+            dst_table = ((np.arange(n, dtype=np.int64) + 1) % n)[:, None]
+            topo = StaticTopology.from_dst_table(dst_table)
+        sys = BatchedSystem(capacity=n, behaviors=[ring_behavior],
+                            payload_width=PAYLOAD_W, host_inbox=8,
+                            topology=topo)
+    sys.spawn_block(ring_behavior, n)
+    return sys
+
+
+def seed_ring_full(sys) -> None:
+    """Every actor holds one token (uniform 1-msg mailbox per BASELINE config)."""
+    n = sys.capacity
+    dst = jnp.arange(n, dtype=jnp.int32)
+    payload = jnp.zeros((n, PAYLOAD_W), dtype=jnp.float32).at[:, 0].set(1.0)
+    if hasattr(sys, "seed_inbox"):
+        sys.seed_inbox(dst, payload)
+    else:  # sharded: place into each shard's exchange region
+        seed_sharded_ring(sys)
+
+
+def seed_sharded_ring(sys: ShardedBatchedSystem) -> None:
+    """Seed one token per actor directly into each shard's self-chunk of the
+    exchange buffer (slot layout: shard s's inbox[s*pair_cap + r])."""
+    import jax
+    n = sys.capacity
+    # inbox is globally [n_shards * m_local]; shard s's block starts at s*m_local;
+    # its self-chunk (from shard s) is at offset s*pair_cap within the block
+    idxs, dsts = [], []
+    for s in range(sys.n_shards):
+        base = s * sys.m_local + s * sys.pair_cap
+        for r in range(min(sys.local_n, sys.pair_cap)):
+            idxs.append(base + r)
+            dsts.append(s * sys.local_n + r)
+    idx = jnp.asarray(idxs)
+    sys.inbox_dst = sys.inbox_dst.at[idx].set(jnp.asarray(dsts, jnp.int32))
+    sys.inbox_payload = sys.inbox_payload.at[idx, 0].set(1.0)
+    sys.inbox_valid = sys.inbox_valid.at[idx].set(True)
+
+
+def build_fan_in(n_leaves: int = 1 << 20, n_collectors: int = 1000,
+                 static: bool = True):
+    n = n_leaves + n_collectors
+    if n % n_collectors:
+        # round capacity so the topology compiler can use the reshape-reduce
+        # (mod) delivery; the padding rows are never spawned
+        n += n_collectors - n % n_collectors
+    topo = None
+    if static:
+        from akka_tpu.ops.segment import StaticTopology
+        ids = np.arange(n, dtype=np.int64)
+        dst_table = np.where(ids >= n_collectors, ids % n_collectors, -1)[:, None]
+        topo = StaticTopology.from_dst_table(dst_table)
+    sys = BatchedSystem(capacity=n, behaviors=[fan_in_collector, fan_in_leaf],
+                        payload_width=PAYLOAD_W, host_inbox=8, topology=topo)
+    sys.spawn_block(fan_in_collector, n_collectors)
+    sys.spawn_block(fan_in_leaf, n_leaves)
+    return sys
+
+
+def build_ping_pong():
+    @behavior("pp", {"hits": ((), jnp.int32)})
+    def pp(state, inbox, ctx):
+        other = 1 - ctx.actor_id
+        return ({"hits": state["hits"] + inbox.count},
+                Emit.single(other, inbox.sum, 1, PAYLOAD_W, when=inbox.count > 0))
+
+    sys = BatchedSystem(capacity=2, behaviors=[pp], payload_width=PAYLOAD_W,
+                        host_inbox=8)
+    sys.spawn_block(pp, 2)
+    return sys

@@ -1,0 +1,305 @@
+"""Delivery primitives: segment reductions over recipient ids.
+
+TPU-native replacement for the reference's MPSC mailbox queues
+(AbstractNodeQueue.java; dispatch/Mailbox.scala:467-497): a step's messages
+are SoA columns (dst, payload, valid) and "enqueue + dequeue" becomes one
+segment reduction per step — sums/maxes/counts land in per-actor slots.
+
+All functions are jit-safe, static-shape, and XLA-fusable. The drop bucket
+(index n_actors) absorbs invalid/out-of-range messages so no dynamic filtering
+is needed.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Delivery(NamedTuple):
+    sum: jax.Array     # [N, P]
+    max: jax.Array     # [N, P]
+    count: jax.Array   # [N] int32
+
+
+def deliver(dst: jax.Array, payload: jax.Array, valid: jax.Array,
+            n_actors: int, need_max: bool = False,
+            mode: str = "sort") -> Delivery:
+    """Reduce messages into per-actor inbox slots.
+
+    dst: [M] int32 recipient ids; payload: [M, P]; valid: [M] bool.
+    Invalid or out-of-range messages fall into a drop bucket.
+
+    mode="scatter" uses XLA scatter-add (segment_sum); mode="sort" sorts by
+    recipient and takes cumulative-sum differences at segment boundaries —
+    much faster on TPU, where scatter serializes but sort/cumsum/gather are
+    vectorized. need_max=False skips the max reduction (a whole extra pass).
+    """
+    if mode == "sort":
+        return _deliver_sorted(dst, payload, valid, n_actors, need_max)
+    return _deliver_scatter(dst, payload, valid, n_actors, need_max)
+
+
+def _deliver_scatter(dst, payload, valid, n_actors: int, need_max: bool) -> Delivery:
+    ok = valid & (dst >= 0) & (dst < n_actors)
+    safe_dst = jnp.where(ok, dst, n_actors)
+    okf = ok[:, None]
+    sums = jax.ops.segment_sum(
+        jnp.where(okf, payload, 0), safe_dst, num_segments=n_actors + 1)
+    counts = jax.ops.segment_sum(
+        ok.astype(jnp.int32), safe_dst, num_segments=n_actors + 1)
+    counts = counts[:n_actors]
+    if need_max:
+        neg_inf = jnp.asarray(-jnp.inf if jnp.issubdtype(payload.dtype, jnp.floating)
+                              else jnp.iinfo(payload.dtype).min, payload.dtype)
+        maxs = jax.ops.segment_max(
+            jnp.where(okf, payload, neg_inf), safe_dst, num_segments=n_actors + 1)
+        maxs = jnp.where((counts > 0)[:, None], maxs[:n_actors], 0)
+    else:
+        maxs = jnp.zeros((n_actors, payload.shape[1]), payload.dtype)
+    return Delivery(sum=sums[:n_actors], max=maxs, count=counts)
+
+
+def _deliver_sorted(dst, payload, valid, n_actors: int, need_max: bool) -> Delivery:
+    """Sort-by-recipient + cumsum-difference segment reduction (no scatter)."""
+    p = payload.shape[1]
+    ok = valid & (dst >= 0) & (dst < n_actors)
+    key = jnp.where(ok, dst, n_actors).astype(jnp.int32)
+    cols = tuple(jnp.where(ok, payload[:, i], 0) for i in range(p))
+    sorted_vals = jax.lax.sort((key,) + cols, num_keys=1)
+    skey, scols = sorted_vals[0], sorted_vals[1:]
+    spayload = jnp.stack(scols, axis=1)                    # [M, P] sorted by dst
+    bounds = jnp.searchsorted(skey, jnp.arange(n_actors + 1, dtype=jnp.int32))
+    start, end = bounds[:-1], bounds[1:]
+    counts = (end - start).astype(jnp.int32)
+    csum = jnp.cumsum(spayload.astype(jnp.float32 if spayload.dtype == jnp.float32
+                                      else spayload.dtype), axis=0)
+    csum = jnp.concatenate([jnp.zeros((1, p), csum.dtype), csum], axis=0)  # prefix w/ 0
+    sums = (csum[end] - csum[start]).astype(payload.dtype)
+    if need_max:
+        neg_inf = jnp.asarray(-jnp.inf if jnp.issubdtype(payload.dtype, jnp.floating)
+                              else jnp.iinfo(payload.dtype).min, payload.dtype)
+        cmax = jax.lax.associative_scan(jnp.maximum,
+                                        jnp.where((skey < n_actors)[:, None],
+                                                  spayload, neg_inf), axis=0)
+        # per-segment max needs a segmented scan; fall back to scatter for max
+        maxs = jax.ops.segment_max(
+            jnp.where((skey < n_actors)[:, None], spayload, neg_inf), skey,
+            num_segments=n_actors + 1)[:n_actors]
+        maxs = jnp.where((counts > 0)[:, None], maxs, 0)
+    else:
+        maxs = jnp.zeros((n_actors, p), payload.dtype)
+    return Delivery(sum=sums, max=maxs, count=counts)
+
+
+class StaticTopology:
+    """Precompiled communication graph: delivery with NO runtime sort/scatter.
+
+    When the actor graph is fixed (ring, trees, fan-in, router pools — the
+    common case, and exactly what maps well to TPUs), the routing can be
+    compiled at build time. `from_dst_table` pattern-matches the graph the way
+    a communication compiler pattern-matches collectives:
+
+    - "shift": dst[i] = (i+c) mod N  ->  delivery is jnp.roll (the on-chip
+      analogue of lax.ppermute; ~memory-copy speed)
+    - "mod":   dst[i] = i mod C      ->  reshape [G, C] + sum over G (the
+      reduction-tree shape of a fan-in; full-bandwidth reduce)
+    - "block": dst[i] = i // G       ->  reshape [C, G] + sum over G
+    - "dense": uniform small fan-in  ->  gather inverse_edges [N, F], sum F
+    - "csr":   anything else         ->  static sort permutation + cumsum
+      differences at static segment boundaries
+
+    Message VALUES and validity stay fully dynamic — only the wiring is static.
+    Kind and scalar params are trace-time constants; only dense/csr carry
+    device arrays (passed as runtime args so the HLO stays small).
+    """
+
+    def __init__(self, kind: str, n: int, k: int, shift: int = 0,
+                 mod: int = 0, block: int = 0, inverse_edges=None,
+                 perm=None, bounds=None):
+        self.kind = kind
+        self.n = n
+        self.k = k
+        self.shift = shift
+        self.mod = mod
+        self.block = block
+        self.inverse_edges = inverse_edges
+        self.perm = perm
+        self.bounds = bounds
+
+    def runtime_arrays(self) -> tuple:
+        """Device arrays to pass through jit as arguments (pytree)."""
+        if self.kind == "dense":
+            return (self.inverse_edges,)
+        if self.kind == "csr":
+            return (self.perm, self.bounds)
+        return ()
+
+    @staticmethod
+    def from_dst_table(dst_table, dense_max_fan_in: int = 4) -> "StaticTopology":
+        """dst_table: [N, K] int — static destination of each actor's k-th
+        out-slot; -1 = unused slot (runtime valid flags gate anyway).
+        Host-side build (numpy)."""
+        import numpy as np
+        dt = np.asarray(dst_table, dtype=np.int64)
+        n, k = dt.shape
+        flat_dst = dt.reshape(-1)
+        m = n * k
+        slots = np.arange(m, dtype=np.int64)
+        okm = flat_dst >= 0
+
+        if k == 1 and okm.any():
+            i_ok = slots[okm]
+            d_ok = flat_dst[okm]
+            # shift: dst = (i + c) mod n, all slots emitting
+            if okm.all():
+                c = int((d_ok[0] - i_ok[0]) % n)
+                if ((i_ok + c) % n == d_ok).all():
+                    return StaticTopology("shift", n, k, shift=c)
+            # mod: dst = i mod C (C = number of distinct targets span)
+            cands = np.unique(d_ok)
+            c_mod = int(cands.max()) + 1
+            if c_mod >= 1 and m % c_mod == 0 and (i_ok % c_mod == d_ok).all():
+                return StaticTopology("mod", n, k, mod=c_mod)
+            # block: dst = i // G
+            if len(cands) > 0:
+                g = m // (int(cands.max()) + 1)
+                if g > 0 and m % g == 0 and (i_ok // g == d_ok).all():
+                    return StaticTopology("block", n, k, block=g)
+
+        order = np.argsort(flat_dst[okm], kind="stable")
+        tgt = flat_dst[okm][order]
+        src = slots[okm][order]
+        counts = np.bincount(tgt, minlength=n) if tgt.size else np.zeros(n, np.int64)
+        f = max(int(counts.max()) if counts.size else 1, 1)
+        if f <= dense_max_fan_in:
+            inv = np.full((n, f), -1, dtype=np.int32)
+            starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+            pos = np.arange(tgt.shape[0]) - starts[tgt]
+            inv[tgt, pos] = src.astype(np.int32)
+            return StaticTopology("dense", n, k, inverse_edges=jnp.asarray(inv))
+        perm = np.concatenate([src, slots[~okm]]).astype(np.int32)
+        bounds = np.concatenate([[0], np.cumsum(counts)]).astype(np.int32)
+        return StaticTopology("csr", n, k, perm=jnp.asarray(perm),
+                              bounds=jnp.asarray(bounds))
+
+
+def deliver_static(topo: StaticTopology, arrays: tuple, payload: jax.Array,
+                   valid: jax.Array, need_max: bool = False) -> Delivery:
+    """Delivery over a static topology; `arrays` = topo.runtime_arrays()
+    passed through jit (payload: [N*K, P] slot-indexed emissions)."""
+    p = payload.shape[1]
+    n = topo.n
+
+    if topo.kind == "shift":
+        in_pl = jnp.roll(payload, topo.shift, axis=0)
+        in_ok = jnp.roll(valid, topo.shift, axis=0)
+        sums = jnp.where(in_ok[:, None], in_pl, 0)
+        counts = in_ok.astype(jnp.int32)
+        maxs = sums if need_max else jnp.zeros_like(sums)
+        return Delivery(sum=sums, max=maxs, count=counts)
+
+    if topo.kind in ("mod", "block"):
+        if topo.kind == "mod":
+            c = topo.mod
+            g = payload.shape[0] // c
+            pl3 = payload.reshape(g, c, p)          # sum over leading groups
+            ok2 = valid.reshape(g, c)
+            axis = 0
+        else:
+            g = topo.block
+            c = payload.shape[0] // g
+            pl3 = payload.reshape(c, g, p)
+            ok2 = valid.reshape(c, g)
+            axis = 1
+        okf = jnp.expand_dims(ok2, -1)
+        sums_c = jnp.sum(jnp.where(okf, pl3, 0), axis=axis)      # [C, P]
+        counts_c = jnp.sum(ok2.astype(jnp.int32), axis=axis)     # [C]
+        # targets are ids [0, C): place into the first C rows
+        c_eff = min(c, n)
+        sums = jnp.zeros((n, p), payload.dtype).at[:c_eff].set(sums_c[:c_eff])
+        counts = jnp.zeros((n,), jnp.int32).at[:c_eff].set(counts_c[:c_eff])
+        if need_max:
+            neg_inf = _neg_inf(payload.dtype)
+            maxs_c = jnp.max(jnp.where(okf, pl3, neg_inf), axis=axis)
+            maxs = jnp.zeros((n, p), payload.dtype).at[:c_eff].set(
+                jnp.where((counts_c > 0)[:, None], maxs_c, 0)[:c_eff])
+        else:
+            maxs = jnp.zeros((n, p), payload.dtype)
+        return Delivery(sum=sums, max=maxs, count=counts)
+
+    if topo.kind == "dense":
+        (inv,) = arrays                          # [N, F] small F
+        safe = jnp.maximum(inv, 0)
+        ok = (inv >= 0) & valid[safe]            # [N, F]
+        gathered = payload[safe]                 # [N, F, P]
+        okf = ok[..., None]
+        sums = jnp.sum(jnp.where(okf, gathered, 0), axis=1)
+        counts = jnp.sum(ok.astype(jnp.int32), axis=1)
+        if need_max:
+            neg_inf = _neg_inf(payload.dtype)
+            maxs = jnp.max(jnp.where(okf, gathered, neg_inf), axis=1)
+            maxs = jnp.where((counts > 0)[:, None], maxs, 0)
+        else:
+            maxs = jnp.zeros(sums.shape, payload.dtype)
+        return Delivery(sum=sums, max=maxs, count=counts)
+
+    # csr: static permutation + cumsum differences
+    perm, bounds = arrays
+    sp = payload[perm]                           # [M, P] dest-sorted (static)
+    sv = valid[perm]
+    sp = jnp.where(sv[:, None], sp, 0)
+    csum = jnp.concatenate([jnp.zeros((1, p), sp.dtype),
+                            jnp.cumsum(sp, axis=0)], axis=0)
+    sums = csum[bounds[1:]] - csum[bounds[:-1]]
+    cvalid = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                              jnp.cumsum(sv.astype(jnp.int32))])
+    counts = cvalid[bounds[1:]] - cvalid[bounds[:-1]]
+    if need_max:
+        seg_ids = jnp.zeros((sp.shape[0],), jnp.int32).at[bounds[1:-1]].add(1)
+        seg_ids = jnp.cumsum(seg_ids)
+        neg_inf = _neg_inf(payload.dtype)
+        maxs = jax.ops.segment_max(jnp.where(sv[:, None], sp, neg_inf), seg_ids,
+                                   num_segments=n)
+        maxs = jnp.where((counts > 0)[:, None], maxs, 0)
+    else:
+        maxs = jnp.zeros(sums.shape, payload.dtype)
+    return Delivery(sum=sums, max=maxs, count=counts)
+
+
+def _neg_inf(dtype):
+    return jnp.asarray(-jnp.inf if jnp.issubdtype(dtype, jnp.floating)
+                       else jnp.iinfo(dtype).min, dtype)
+
+
+def route_one_hop(dst: jax.Array, perm_table: jax.Array) -> jax.Array:
+    """Rewrite destinations through a routing table (router logics as index
+    maps — SURVEY.md §2.11: RoundRobin = iota mod n, ConsistentHash = hash
+    tensor)."""
+    return perm_table[dst]
+
+
+def compact_messages(dst: jax.Array, payload: jax.Array, valid: jax.Array,
+                     capacity: int) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Stable-compact valid messages to the front of a fixed-size buffer.
+
+    Returns (dst, payload, valid, dropped_count). Stable order preserves
+    per-sender FIFO (SURVEY.md §7 hard parts: ordering under scatter delivery).
+    """
+    m = dst.shape[0]
+    # positions of valid messages in stable order
+    order = jnp.argsort(jnp.where(valid, 0, 1), stable=True)
+    dst_s = dst[order]
+    payload_s = payload[order]
+    valid_s = valid[order]
+    n_valid = jnp.sum(valid.astype(jnp.int32))
+    if capacity >= m:
+        pad = capacity - m
+        return (jnp.pad(dst_s, (0, pad), constant_values=-1),
+                jnp.pad(payload_s, ((0, pad), (0, 0))),
+                jnp.pad(valid_s, (0, pad)),
+                jnp.asarray(0, jnp.int32))
+    dropped = jnp.maximum(n_valid - capacity, 0)
+    return dst_s[:capacity], payload_s[:capacity], valid_s[:capacity], dropped

@@ -1,0 +1,52 @@
+"""Device mesh helpers: the ICI/DCN communication substrate.
+
+This replaces the reference's Artery transport stack (remote/artery/
+ArteryTransport.scala:328 — Aeron UDP lanes between JVMs) with XLA collectives
+over the TPU interconnect: cross-shard tells ride `all_to_all`/`ppermute`
+inside the jitted step (ICI), and multi-host control goes through
+jax.distributed (DCN). See SURVEY.md §2.3 "TPU-native equivalent".
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: Optional[int] = None, axis_name: str = "shards",
+              devices: Optional[Sequence] = None) -> Mesh:
+    """1D mesh over the actor-shard axis."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            if len(devices) < n_devices:
+                raise ValueError(
+                    f"need {n_devices} devices, have {len(devices)} "
+                    f"(hint: XLA_FLAGS=--xla_force_host_platform_device_count=N)")
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def make_mesh_2d(dp: int, tp: int, axis_names=("dp", "tp"),
+                 devices: Optional[Sequence] = None) -> Mesh:
+    """2D mesh for layered parallelism (shard axis x replication axis)."""
+    if devices is None:
+        devices = jax.devices()[: dp * tp]
+    return Mesh(np.asarray(devices).reshape(dp, tp), axis_names)
+
+
+def shard_spec(mesh: Mesh, axis_name: str = "shards") -> NamedSharding:
+    """Rows sharded over the mesh axis (actor axis / shard axis)."""
+    return NamedSharding(mesh, P(axis_name))
+
+
+def replicated_spec(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def host_device_count() -> int:
+    return jax.device_count()

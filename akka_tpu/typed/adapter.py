@@ -80,19 +80,34 @@ class TypedActorContext:
         return self.system.scheduler.schedule_tell_once(delay, target, msg, self.self)
 
     def message_adapter(self, fn: Callable[[Any], Any], for_type: type = object) -> ActorRef:
-        """Adapter ref translating foreign replies into our protocol
-        (reference: ActorContext.messageAdapter)."""
+        """Adapter ref translating foreign replies into our protocol.
+        Re-registering for the same type replaces the function (reference:
+        ActorContext.messageAdapter semantics)."""
         key = for_type
+        self._adapter_fns = getattr(self, "_adapter_fns", {})
+        self._adapter_fns[key] = fn
         if key in self._adapters:
             return self._adapters[key]
         me = self.self
+        fns = self._adapter_fns
 
         def _handler(msg, sender):
-            me.tell(fn(msg), sender)
+            me.tell(fns[key](msg), sender)
 
         ref = self.system.provider.create_function_ref(_handler)
         self._adapters[key] = ref
         return ref
+
+    def _release_resources(self) -> None:
+        """Stop adapter refs + cancel timers when the actor stops."""
+        for ref in self._adapters.values():
+            try:
+                self.system.provider.stop_function_ref(ref)
+            except Exception:  # noqa: BLE001
+                pass
+        self._adapters.clear()
+        for ts in getattr(self, "_timer_schedulers", []):
+            ts.cancel_all()
 
     def pipe_to_self(self, future: Future, map_result: Callable[[Any, Optional[BaseException]], Any]) -> None:
         me = self.self
@@ -138,8 +153,10 @@ class TypedActorAdapter(Actor):
 
     def _receive(self, message: Any):
         if isinstance(message, ClassicTerminated):
-            cause = None
-            sig = Terminated(message.actor) if cause is None else ChildFailed(message.actor, cause)
+            cause = getattr(message, "cause", None)
+            is_child = message.actor.path.parent == self.context.self_ref.path
+            sig = (ChildFailed(message.actor, cause) if (cause is not None and is_child)
+                   else Terminated(message.actor))
             nxt = interpret_signal(self._behavior, self.ctx, sig)
             if is_unhandled(nxt):
                 # typed semantics: unhandled Terminated throws DeathPactException
@@ -166,6 +183,7 @@ class TypedActorAdapter(Actor):
             self.context.stop()
 
     def post_stop(self) -> None:
+        self.ctx._release_resources()
         b = self._behavior
         if isinstance(b, StoppedBehavior) and b.post_stop_cb is not None:
             try:

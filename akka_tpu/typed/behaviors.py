@@ -54,6 +54,11 @@ class Behaviors:
     def with_timers(factory: Callable[["TimerScheduler"], Behavior]) -> Behavior:
         def _setup(ctx):
             timers = TimerScheduler(ctx)
+            # registered so the adapter / supervisor cancels them on
+            # stop/restart (the reference cancels on PostStop/PreRestart)
+            if not hasattr(ctx, "_timer_schedulers"):
+                ctx._timer_schedulers = []
+            ctx._timer_schedulers.append(timers)
             return factory(timers)
         return DeferredBehavior(_setup)
 
@@ -171,9 +176,7 @@ class _Supervisor(BehaviorInterceptor):
                 return FailedBehavior(exc)
             self._restarts.append(now)
             self._signal_restart(ctx)
-            if s.stop_children:
-                for child in list(ctx.children):
-                    ctx.stop(child)
+            self._stop_children(ctx)
             return start(self.initial, ctx)
         if s.kind == "backoff":
             delay = min(s.min_backoff * (2 ** self._backoff_count), s.max_backoff)
@@ -181,23 +184,44 @@ class _Supervisor(BehaviorInterceptor):
             self._backoff_count += 1
             self._generation += 1
             self._signal_restart(ctx)
-            if s.stop_children:
-                for child in list(ctx.children):
-                    ctx.stop(child)
+            self._stop_children(ctx)
             gen = self._generation
             ctx.schedule_once(delay, ctx.self, _ScheduledRestart(gen))
-            # while backing off, stash nothing; drop messages to deadletters? the
-            # reference drops to deadLetters while waiting — we ignore
+            # while backing off, messages are dropped (the reference dead-letters)
             return Behaviors.ignore
         return FailedBehavior(exc)
 
+    def _stop_children(self, ctx) -> None:
+        if not self.strategy.stop_children:
+            return
+        cell = getattr(ctx, "_cell", None)
+        for child in list(ctx.children):
+            ctx.stop(child)
+            # free the name immediately so a re-run setup can respawn it: the
+            # old incarnation keeps terminating under a distinct uid (diverges
+            # from the reference, which reserves the name until termination)
+            if cell is not None:
+                cell._children.pop(child.path.name, None)
+                cell._child_stats.pop(child.path.name, None)
+
     def _signal_restart(self, ctx) -> None:
+        """Deliver PreRestart to the NESTED behavior (not through this
+        interceptor — a raising PreRestart handler must not recurse into
+        _handle and burn the restart budget)."""
         try:
             cur = getattr(ctx, "_current_behavior", None)
-            if cur is not None:
+            while isinstance(cur, InterceptedBehavior):
+                if cur.interceptor is self:
+                    cur = cur.nested
+                    break
+                cur = cur.nested
+            if cur is not None and is_alive(cur):
                 interpret_signal(cur, ctx, PreRestart)
         except Exception:  # noqa: BLE001
             pass
+        # cancel this incarnation's timers (with_timers registers on the ctx)
+        for ts in getattr(ctx, "_timer_schedulers", []):
+            ts.cancel_all()
 
 
 class Supervise:
@@ -206,7 +230,11 @@ class Supervise:
 
     def on_failure(self, strategy: SupervisorStrategy,
                    exc_type: Type[BaseException] = Exception) -> Behavior:
-        return InterceptedBehavior(_Supervisor(self.behavior, strategy, exc_type), self.behavior)
+        # deferred so each spawned actor gets a FRESH supervisor instance —
+        # the interceptor holds per-actor state (_restarts/_generation)
+        behavior = self.behavior
+        return DeferredBehavior(lambda _ctx: InterceptedBehavior(
+            _Supervisor(behavior, strategy, exc_type), behavior))
 
 
 # -- timers (reference: typed/scaladsl/TimerScheduler, TimerSchedulerImpl) ----
@@ -282,8 +310,13 @@ class StashBuffer:
         (reference: StashBufferImpl.unstashAll)."""
         b = start(behavior, self._ctx)
         msgs, self._buf = self._buf, []
-        for m in msgs:
+        for i, m in enumerate(msgs):
             if not is_alive(b):
+                # dead-letter the rest (mirrors classic Stash.post_stop)
+                from ..actor.messages import DeadLetter
+                dl = self._ctx.system.dead_letters
+                for rest in msgs[i:]:
+                    dl.tell(DeadLetter(rest, self._ctx.self, self._ctx.self), None)
                 break
             nxt = interpret_message(b, self._ctx, m)
             b = canonicalize(nxt, b, self._ctx)

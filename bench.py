@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Headline benchmark: actor.tell() throughput on the 1M-actor ring.
+
+BASELINE.json: target 100M actor.tell()/sec on 1M concurrent actors
+(>=10x the ForkJoinDispatcher JMH baseline, i.e. baseline ~= 10M msg/s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Extra detail goes to stderr. --smoke runs a tiny config for CI.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+BASELINE_MSGS_PER_SEC = 10_000_000  # implied ForkJoinDispatcher JMH reference
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="tiny config, CPU-ok")
+    ap.add_argument("--actors", type=int, default=1 << 20)
+    ap.add_argument("--steps", type=int, default=64)
+    ap.add_argument("--warmup", type=int, default=0,
+                    help="warmup steps (default: same as --steps so the scan "
+                         "compiles once for the measured length)")
+    ap.add_argument("--all", action="store_true", help="also run fan-in/ping-pong to stderr")
+    args = ap.parse_args()
+
+    if args.smoke:
+        args.actors, args.steps = 1 << 12, 8
+    if args.warmup <= 0:
+        args.warmup = args.steps  # same scan length -> one compile
+
+    import jax
+    from akka_tpu.models.baseline_benches import build_ring, seed_ring_full
+
+    dev = jax.devices()[0]
+    print(f"[bench] device: {dev.platform}:{dev.device_kind} "
+          f"actors={args.actors} steps={args.steps}", file=sys.stderr)
+
+    sys_ = build_ring(args.actors)
+    seed_ring_full(sys_)
+
+    # warmup (compile)
+    t0 = time.perf_counter()
+    sys_.run(args.warmup)
+    sys_.block_until_ready()
+    print(f"[bench] compile+warmup: {time.perf_counter()-t0:.1f}s", file=sys.stderr)
+
+    t0 = time.perf_counter()
+    sys_.run(args.steps)
+    sys_.block_until_ready()
+    elapsed = time.perf_counter() - t0
+
+    delivered = args.actors * args.steps  # every actor processes 1 msg per step
+    msgs_per_sec = delivered / elapsed
+
+    # correctness guard: each actor received warmup+steps messages
+    recv = sys_.read_state("received")
+    expected = args.warmup + args.steps
+    ok = bool((recv == expected).all())
+    print(f"[bench] elapsed={elapsed:.3f}s delivered={delivered:,} "
+          f"({msgs_per_sec/1e6:.1f}M msg/s) correctness={'OK' if ok else 'FAIL'}",
+          file=sys.stderr)
+    if not ok:
+        print(f"[bench] expected {expected}, got min={recv.min()} max={recv.max()}",
+              file=sys.stderr)
+
+    if args.all:
+        _extra_benches(args, file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "actor.tell() throughput, 1M-actor ring (uniform 1-msg mailbox)",
+        "value": round(msgs_per_sec, 0),
+        "unit": "msgs/sec",
+        "vs_baseline": round(msgs_per_sec / BASELINE_MSGS_PER_SEC, 2),
+    }))
+
+
+def _extra_benches(args, file) -> None:
+    import time as _t
+    from akka_tpu.models.baseline_benches import build_fan_in, build_ping_pong
+
+    n_leaves = min(args.actors, 1 << 20)
+    fi = build_fan_in(n_leaves=n_leaves, n_collectors=1000)
+    fi.run(2); fi.block_until_ready()
+    t0 = _t.perf_counter()
+    fi.run(args.steps); fi.block_until_ready()
+    dt = _t.perf_counter() - t0
+    print(f"[bench] fan-in {n_leaves}->1000: "
+          f"{n_leaves*args.steps/dt/1e6:.1f}M msg/s", file=file)
+
+    pp = build_ping_pong()
+    pp.tell(0, [1.0, 0, 0, 0])
+    pp.run(2); pp.block_until_ready()
+    t0 = _t.perf_counter()
+    pp.run(1000); pp.block_until_ready()
+    dt = _t.perf_counter() - t0
+    print(f"[bench] ping-pong: {1000/dt:.0f} round-trips/s "
+          f"(p50 step latency {dt:.4f}/1000 = {dt*1e3:.3f}ms... per-step {dt:.3f}us)",
+          file=file)
+
+
+if __name__ == "__main__":
+    main()

@@ -4,8 +4,15 @@ on a simulated mesh via xla_force_host_platform_device_count)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force-override: the ambient env may preset JAX_PLATFORMS to a TPU platform
+# (and a sitecustomize may have registered + selected it before conftest runs),
+# so set both the env var and the live jax config
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,103 @@
+"""Sharded batched system on a virtual 8-device CPU mesh (SURVEY.md §4:
+multi-node tests on xla_force_host_platform_device_count)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from akka_tpu.batched import Emit, behavior
+from akka_tpu.batched.sharded import ShardedBatchedSystem
+
+
+def make_ring():
+    @behavior("ring", {"received": ((), jnp.int32), "last": ((), jnp.float32)})
+    def ring(state, inbox, ctx):
+        nxt = (ctx.actor_id + 1) % ctx.n_actors
+        token = inbox.sum[0]
+        return ({"received": state["received"] + inbox.count,
+                 "last": token.astype(jnp.float32)},
+                Emit.single(nxt, jnp.stack([token + 1, 0.0, 0.0, 0.0]), 1, 4,
+                            when=inbox.count > 0))
+    return ring
+
+
+@pytest.fixture(scope="module")
+def n_dev():
+    assert jax.device_count() >= 8, "conftest must force 8 CPU devices"
+    return 8
+
+
+def test_cross_shard_ring(n_dev):
+    # 32 actors over 8 shards: the token crosses a shard boundary every 4 hops
+    n = 32
+    ring = make_ring()
+    sys = ShardedBatchedSystem(capacity=n, behaviors=[ring], n_devices=n_dev,
+                               payload_width=4)
+    sys.spawn_block(ring, n)
+    sys.tell(0, [1.0, 0, 0, 0])
+    steps = 40  # full wrap + 8 more
+    for _ in range(steps):
+        sys.run(1)
+    received = sys.read_state("received")
+    expected = np.zeros(n, dtype=np.int32)
+    for k in range(steps):
+        expected[k % n] += 1
+    np.testing.assert_array_equal(received, expected)
+    assert sys.total_dropped == 0
+
+
+def test_cross_shard_fan_in(n_dev):
+    # leaves on all shards tell collector (actor 0 on shard 0) every step
+    n = 64
+
+    @behavior("leaf", {}, always_on=True)
+    def leaf(state, inbox, ctx):
+        return {}, Emit.single(0, jnp.array([1.0, 0, 0, 0]), 1, 4,
+                               when=ctx.actor_id > 0)
+
+    @behavior("collector", {"total": ((), jnp.float32), "msgs": ((), jnp.int32)})
+    def collector(state, inbox, ctx):
+        return {"total": state["total"] + inbox.sum[0],
+                "msgs": state["msgs"] + inbox.count}, Emit.none(1, 4)
+
+    sys = ShardedBatchedSystem(capacity=n, behaviors=[collector, leaf],
+                               n_devices=n_dev, payload_width=4)
+    sys.spawn_block(collector, 1)
+    sys.spawn_block(leaf, n - 1)
+    steps = 4
+    sys.run(steps)
+    assert sys.read_state("msgs")[0] == (n - 1) * (steps - 1)
+    assert sys.read_state("total")[0] == float((n - 1) * (steps - 1))
+
+
+def test_scan_multi_step_equivalence(n_dev):
+    n = 16
+    ring = make_ring()
+    a = ShardedBatchedSystem(capacity=n, behaviors=[ring], n_devices=n_dev)
+    b = ShardedBatchedSystem(capacity=n, behaviors=[ring], n_devices=n_dev)
+    for s in (a, b):
+        s.spawn_block(ring, n)
+        s.tell(0, [1.0, 0, 0, 0])
+    a.run(12)           # one scan of 12
+    for _ in range(12):  # 12 separate steps
+        b.run(1)
+    np.testing.assert_array_equal(a.read_state("received"), b.read_state("received"))
+    np.testing.assert_array_equal(a.read_state("last"), b.read_state("last"))
+
+
+def test_overflow_drops_counted(n_dev):
+    # tiny remote capacity: everything targets shard 0 from all shards
+    n = 64
+
+    @behavior("spam", {}, always_on=True)
+    def spam(state, inbox, ctx):
+        return {}, Emit.single(0, jnp.array([1.0, 0, 0, 0]), 1, 4)
+
+    sys = ShardedBatchedSystem(capacity=n, behaviors=[spam], n_devices=n_dev,
+                               remote_capacity_per_pair=2)
+    sys.spawn_block(spam, n)
+    sys.run(3)
+    # 8 actors/shard spam shard 0 but only 2/pair/step survive
+    assert sys.total_dropped > 0
