@@ -1,0 +1,45 @@
+"""Routing logics as index maps — the batched/device tier.
+
+SURVEY.md §2.11: RoundRobin = iota mod n; Random = hashed counter;
+ConsistentHash = hash tensor mod n. These produce destination-id tensors
+consumed by BatchedBehavior emissions, so a 100k-routee RoundRobinPool routes
+entirely on device (BASELINE config 4).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def round_robin_dst(n_messages: int, routee_base: int, n_routees: int,
+                    offset=0) -> jax.Array:
+    """Destination ids for n_messages round-robin over routees
+    [routee_base, routee_base + n_routees)."""
+    return routee_base + (jnp.arange(n_messages, dtype=jnp.int32) + offset) % n_routees
+
+
+def random_dst(key: jax.Array, n_messages: int, routee_base: int,
+               n_routees: int) -> jax.Array:
+    return routee_base + jax.random.randint(key, (n_messages,), 0, n_routees, jnp.int32)
+
+
+def _fnv1a(x: jax.Array) -> jax.Array:
+    """Vectorized 32-bit FNV-1a-style mix of int32 keys (device-side stand-in
+    for the reference's MurmurHash, routing/MurmurHash.scala)."""
+    x = x.astype(jnp.uint32)
+    h = jnp.uint32(2166136261)
+    for shift in (0, 8, 16, 24):
+        byte = (x >> shift) & jnp.uint32(0xFF)
+        h = (h ^ byte) * jnp.uint32(16777619)
+    return h
+
+
+def consistent_hash_dst(keys: jax.Array, routee_base: int, n_routees: int) -> jax.Array:
+    """Map int32 hash keys to stable routee destinations."""
+    return routee_base + (_fnv1a(keys) % jnp.uint32(n_routees)).astype(jnp.int32)
+
+
+def broadcast_dst(n_routees: int, routee_base: int) -> jax.Array:
+    """All routees (use with out_degree = n_routees emissions)."""
+    return routee_base + jnp.arange(n_routees, dtype=jnp.int32)

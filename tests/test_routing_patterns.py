@@ -1,0 +1,246 @@
+"""Router + pattern tests (modeled on akka-actor-tests routing/pattern specs)."""
+
+import threading
+import time
+
+import pytest
+
+from akka_tpu import Actor, ActorSystem, Props, ask_sync
+from akka_tpu.routing.router import (AdjustPoolSize, Broadcast, BroadcastPool,
+                                     ConsistentHashingPool, GetRoutees,
+                                     RandomPool, RoundRobinGroup, RoundRobinPool,
+                                     Routees)
+from akka_tpu.pattern.circuit_breaker import (CircuitBreaker,
+                                              CircuitBreakerOpenException)
+from akka_tpu.pattern.backoff import (BackoffSupervisor, GetRestartCount,
+                                      RestartCount, graceful_stop, retry)
+from akka_tpu.actor.fsm import FSM, Event
+
+
+@pytest.fixture()
+def system():
+    sys = ActorSystem.create("rt", {"akka": {"stdout-loglevel": "OFF",
+                                             "log-dead-letters": 0}})
+    yield sys
+    sys.terminate()
+    assert sys.await_termination(10.0)
+
+
+class Echo(Actor):
+    def receive(self, message):
+        self.sender.tell((self.self_ref.path.name, message), self.self_ref)
+
+
+class Collector(Actor):
+    results = []
+    lock = threading.Lock()
+
+    def receive(self, message):
+        with Collector.lock:
+            Collector.results.append((self.self_ref.path.name, message))
+
+
+def test_round_robin_pool_distributes(system):
+    Collector.results = []
+    router = system.actor_of(Props.create(Collector).with_router(RoundRobinPool(4)),
+                             "rr")
+    for i in range(20):
+        router.tell(i)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and len(Collector.results) < 20:
+        time.sleep(0.02)
+    assert len(Collector.results) == 20
+    by_routee = {}
+    for name, _ in Collector.results:
+        by_routee[name] = by_routee.get(name, 0) + 1
+    assert len(by_routee) == 4
+    assert all(v == 5 for v in by_routee.values())
+
+
+def test_broadcast_pool(system):
+    Collector.results = []
+    router = system.actor_of(Props.create(Collector).with_router(BroadcastPool(3)))
+    router.tell("x")
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and len(Collector.results) < 3:
+        time.sleep(0.02)
+    assert len(Collector.results) == 3
+
+
+def test_broadcast_envelope_on_round_robin(system):
+    Collector.results = []
+    router = system.actor_of(Props.create(Collector).with_router(RoundRobinPool(3)))
+    router.tell(Broadcast("all"))
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and len(Collector.results) < 3:
+        time.sleep(0.02)
+    assert len(Collector.results) == 3
+
+
+def test_get_routees_and_resize(system):
+    router = system.actor_of(Props.create(Echo).with_router(RoundRobinPool(2)))
+    r = ask_sync(router, GetRoutees())
+    assert isinstance(r, Routees) and len(r.routees) == 2
+    router.tell(AdjustPoolSize(3))
+    time.sleep(0.2)
+    r = ask_sync(router, GetRoutees())
+    assert len(r.routees) == 5
+
+
+def test_consistent_hashing_same_key_same_routee(system):
+    router = system.actor_of(
+        Props.create(Echo).with_router(
+            ConsistentHashingPool(5, hash_mapping=lambda m: m[0])))
+    first = ask_sync(router, ("key-a", 1))[0]
+    for _ in range(5):
+        assert ask_sync(router, ("key-a", 2))[0] == first
+
+
+def test_group_router(system):
+    system.actor_of(Props.create(Echo), "w1")
+    system.actor_of(Props.create(Echo), "w2")
+    time.sleep(0.1)
+    router = system.actor_of(
+        Props.from_receive(lambda ctx, m: None).with_router(
+            RoundRobinGroup(["akka://rt/user/w1", "akka://rt/user/w2"])))
+    names = {ask_sync(router, "hi")[0] for _ in range(4)}
+    assert names == {"w1", "w2"}
+
+
+def test_pool_respawns_dead_routee(system):
+    class Dying(Actor):
+        def receive(self, message):
+            if message == "die":
+                raise RuntimeError("x")
+            self.sender.tell("ok", self.self_ref)
+
+    router = system.actor_of(
+        Props.create(Dying).with_router(RoundRobinPool(2)))
+    router.tell(Broadcast("die"))
+    time.sleep(0.3)
+    r = ask_sync(router, GetRoutees())
+    assert len(r.routees) == 2  # pool keeps its size
+
+
+def test_circuit_breaker_trips_and_recovers(system):
+    cb = CircuitBreaker(system.scheduler, max_failures=2, call_timeout=1.0,
+                        reset_timeout=0.2)
+    events = []
+    cb.on_open(lambda: events.append("open"))
+    cb.on_half_open(lambda: events.append("half-open"))
+    cb.on_close(lambda: events.append("close"))
+
+    def boom():
+        raise ValueError("nope")
+
+    for _ in range(2):
+        with pytest.raises(ValueError):
+            cb.call(boom)
+    assert cb.state == "open"
+    with pytest.raises(CircuitBreakerOpenException):
+        cb.call(lambda: 1)
+    time.sleep(0.25)
+    assert cb.state == "half-open"
+    assert cb.call(lambda: 42) == 42
+    assert cb.state == "closed"
+    assert events == ["open", "half-open", "close"]
+
+
+def test_circuit_breaker_reopens_from_half_open(system):
+    cb = CircuitBreaker(system.scheduler, max_failures=1, call_timeout=1.0,
+                        reset_timeout=0.15, exponential_backoff_factor=2.0)
+    with pytest.raises(ValueError):
+        cb.call(lambda: (_ for _ in ()).throw(ValueError()))
+    time.sleep(0.2)
+    assert cb.state == "half-open"
+    with pytest.raises(ValueError):
+        cb.call(lambda: (_ for _ in ()).throw(ValueError()))
+    assert cb.state == "open"
+
+
+def test_backoff_supervisor_restarts_child(system):
+    class Crashy(Actor):
+        def receive(self, message):
+            if message == "boom":
+                raise RuntimeError("crash")
+            self.sender.tell("alive", self.self_ref)
+
+    sup = system.actor_of(BackoffSupervisor.props(
+        Props.create(Crashy), "crashy", min_backoff=0.05, max_backoff=0.5))
+    assert ask_sync(sup, "ping") == "alive"
+    sup.tell("boom")
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        rc = ask_sync(sup, GetRestartCount())
+        if isinstance(rc, RestartCount) and rc.count >= 1:
+            break
+        time.sleep(0.05)
+    # child respawned after backoff
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        try:
+            if ask_sync(sup, "ping", timeout=1.0) == "alive":
+                break
+        except Exception:
+            pass
+    assert ask_sync(sup, "ping") == "alive"
+
+
+def test_retry_succeeds_after_failures(system):
+    from concurrent.futures import Future
+    attempts = [0]
+
+    def attempt():
+        attempts[0] += 1
+        f = Future()
+        if attempts[0] < 3:
+            f.set_exception(RuntimeError(f"fail {attempts[0]}"))
+        else:
+            f.set_result("done")
+        return f
+
+    out = retry(attempt, attempts=5, delay=0.02, scheduler=system.scheduler)
+    assert out.result(5.0) == "done"
+    assert attempts[0] == 3
+
+
+def test_graceful_stop(system):
+    echo = system.actor_of(Props.create(Echo))
+    fut = graceful_stop(echo, 5.0, system)
+    assert fut.result(5.0) is True
+    assert echo.is_terminated
+
+
+def test_fsm_transitions_and_timers(system):
+    transitions = []
+    done = threading.Event()
+
+    class Light(FSM):
+        def __init__(self):
+            super().__init__()
+            self.when("red", self.red)
+            self.when("green", self.green, state_timeout=0.1)
+            self.on_transition(lambda a, b: transitions.append((a, b)))
+            self.start_with("red", None)
+            self.initialize()
+
+        def red(self, event):
+            if event.event == "go":
+                return self.goto("green")
+            if event.event == "status":
+                return self.stay().replying(("state", self.state_name))
+            return None
+
+        def green(self, event):
+            from akka_tpu.actor.fsm import STATE_TIMEOUT
+            if event.event is STATE_TIMEOUT:
+                done.set()
+                return self.goto("red")
+            return None
+
+    fsm = system.actor_of(Props.create(Light))
+    assert ask_sync(fsm, "status") == ("state", "red")
+    fsm.tell("go")
+    assert done.wait(5.0)  # state timeout fired
+    time.sleep(0.1)
+    assert transitions == [("red", "green"), ("green", "red")]
