@@ -1,0 +1,254 @@
+"""Transports: the host control-plane wire (TCP + in-process).
+
+Reference parity: akka-remote Artery transports — TCP framing
+(remote/artery/tcp/ArteryTcpTransport.scala, TcpFraming.scala) and the
+scriptable TestTransport (remote/transport/TestTransport.scala). The in-proc
+transport doubles as the multi-node testkit's fault-injectable link
+(ThrottlerTransportAdapter.scala:212 / FailureInjectorTransportAdapter.scala:65
+semantics via FaultInjector).
+
+On TPU pods the DATA plane is the sharded step's all_to_all over ICI
+(akka_tpu/batched/sharded.py); these transports carry the control plane
+(membership gossip, remote watch, system messages) the way Artery's control
+lane does (ArteryTransport.scala:383-397).
+"""
+
+from __future__ import annotations
+
+import pickle
+import queue
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ..actor.path import Address
+
+_LEN = struct.Struct(">I")
+
+
+@dataclass
+class WireEnvelope:
+    """What crosses the wire (reference: artery Codecs.scala EnvelopeBuffer
+    layout — recipient, sender, serializer id, class manifest, payload; plus
+    the system-message seq/ack channel of SystemMessageDelivery.scala)."""
+
+    recipient: str                 # serialization-format path
+    sender: Optional[str]
+    serializer_id: int
+    manifest: str
+    payload: bytes
+    is_system: bool = False
+    seq: Optional[int] = None      # system-message sequence number
+    ack: Optional[int] = None      # cumulative ack
+    from_address: str = ""
+    from_uid: int = 0
+    lane: str = "ordinary"         # control | ordinary | large
+
+    def to_bytes(self) -> bytes:
+        return pickle.dumps(self, protocol=pickle.HIGHEST_PROTOCOL)
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "WireEnvelope":
+        return pickle.loads(data)
+
+
+InboundHandler = Callable[[WireEnvelope], None]
+
+
+class Transport:
+    scheme = "akka"
+
+    def listen(self, host: str, port: int, handler: InboundHandler) -> Tuple[str, int]:
+        raise NotImplementedError
+
+    def send(self, host: str, port: int, envelope: WireEnvelope) -> bool:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class FaultInjector:
+    """Per-link fault injection (reference: TestConductor throttle/blackhole,
+    remote/testconductor/Conductor.scala:128,148)."""
+
+    def __init__(self):
+        self._modes: Dict[Tuple[str, str], Any] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _key(a: str, b: str) -> Tuple[str, str]:
+        return (a, b)
+
+    def blackhole(self, from_addr: str, to_addr: str) -> None:
+        with self._lock:
+            self._modes[(from_addr, to_addr)] = "blackhole"
+
+    def throttle(self, from_addr: str, to_addr: str, rate_msgs_per_sec: float) -> None:
+        with self._lock:
+            self._modes[(from_addr, to_addr)] = ("throttle", rate_msgs_per_sec, [0.0])
+
+    def pass_through(self, from_addr: str, to_addr: str) -> None:
+        with self._lock:
+            self._modes.pop((from_addr, to_addr), None)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._modes.clear()
+
+    def allow(self, from_addr: str, to_addr: str) -> bool:
+        """False -> drop; may sleep for throttling."""
+        with self._lock:
+            mode = self._modes.get((from_addr, to_addr))
+        if mode is None:
+            return True
+        if mode == "blackhole":
+            return False
+        if isinstance(mode, tuple) and mode[0] == "throttle":
+            _, rate, last = mode
+            now = time.monotonic()
+            min_gap = 1.0 / max(rate, 1e-9)
+            if now - last[0] < min_gap:
+                time.sleep(min_gap - (now - last[0]))
+            last[0] = time.monotonic()
+            return True
+        return True
+
+
+class InProcTransport(Transport):
+    """Process-local 'network': multi-node tests run N systems in one process
+    with real serialization + fault injection, no sockets."""
+
+    _registry: Dict[Tuple[str, int], InboundHandler] = {}
+    _reg_lock = threading.Lock()
+    _port_counter = [20000]
+    fault_injector = FaultInjector()
+
+    def __init__(self, local_address: str = ""):
+        self.local_address = local_address
+        self._executor = None
+        self._bound: Optional[Tuple[str, int]] = None
+
+    def listen(self, host: str, port: int, handler: InboundHandler) -> Tuple[str, int]:
+        with self._reg_lock:
+            if port == 0:
+                self._port_counter[0] += 1
+                port = self._port_counter[0]
+            self._registry[(host, port)] = handler
+            self._bound = (host, port)
+        return host, port
+
+    def send(self, host: str, port: int, envelope: WireEnvelope) -> bool:
+        handler = self._registry.get((host, port))
+        if handler is None:
+            return False
+        to_addr = f"{host}:{port}"
+        if not self.fault_injector.allow(self.local_address, to_addr):
+            return False
+        # deliver on a fresh stack to mimic network asynchrony
+        threading.Thread(target=handler, args=(envelope,), daemon=True).start()
+        return True
+
+    def shutdown(self) -> None:
+        with self._reg_lock:
+            if self._bound is not None:
+                self._registry.pop(self._bound, None)
+
+
+class TcpTransport(Transport):
+    """Framed TCP: 4-byte big-endian length + pickled WireEnvelope. One
+    outbound connection per peer, kept open (Artery-tcp-like)."""
+
+    def __init__(self, local_address: str = ""):
+        self.local_address = local_address
+        self._server_sock: Optional[socket.socket] = None
+        self._conns: Dict[Tuple[str, int], socket.socket] = {}
+        self._conn_lock = threading.Lock()
+        self._stop = threading.Event()
+        self.fault_injector = FaultInjector()
+
+    def listen(self, host: str, port: int, handler: InboundHandler) -> Tuple[str, int]:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(128)
+        self._server_sock = srv
+        bound_host, bound_port = srv.getsockname()
+
+        def accept_loop():
+            while not self._stop.is_set():
+                try:
+                    conn, _ = srv.accept()
+                except OSError:
+                    return
+                threading.Thread(target=self._read_loop, args=(conn, handler),
+                                 daemon=True).start()
+
+        threading.Thread(target=accept_loop, daemon=True,
+                         name=f"akka-tpu-tcp-accept-{bound_port}").start()
+        return bound_host, bound_port
+
+    def _read_loop(self, conn: socket.socket, handler: InboundHandler) -> None:
+        try:
+            buf = b""
+            while not self._stop.is_set():
+                while len(buf) < 4:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                (length,) = _LEN.unpack(buf[:4])
+                while len(buf) < 4 + length:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                frame, buf = buf[4: 4 + length], buf[4 + length:]
+                try:
+                    handler(WireEnvelope.from_bytes(frame))
+                except Exception:  # noqa: BLE001 — bad frame must not kill the loop
+                    pass
+        finally:
+            conn.close()
+
+    def send(self, host: str, port: int, envelope: WireEnvelope) -> bool:
+        if not self.fault_injector.allow(self.local_address, f"{host}:{port}"):
+            return False
+        data = envelope.to_bytes()
+        frame = _LEN.pack(len(data)) + data
+        with self._conn_lock:
+            sock = self._conns.get((host, port))
+            if sock is None:
+                try:
+                    sock = socket.create_connection((host, port), timeout=5.0)
+                except OSError:
+                    return False
+                self._conns[(host, port)] = sock
+            try:
+                sock.sendall(frame)
+                return True
+            except OSError:
+                self._conns.pop((host, port), None)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return False
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._server_sock is not None:
+            try:
+                self._server_sock.close()
+            except OSError:
+                pass
+        with self._conn_lock:
+            for s in self._conns.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._conns.clear()

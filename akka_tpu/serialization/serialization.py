@@ -1,0 +1,179 @@
+"""Serialization: id->serializer registry with class bindings + manifests.
+
+Reference parity: akka-actor/src/main/scala/akka/serialization/ —
+`Serialization.findSerializerFor` walks class->serializer bindings (most
+specific class wins, Serialization.scala:291), serializers carry integer ids
+and optional string manifests (Serializer.scala SerializerWithStringManifest),
+bindings come from config `serialization-bindings` (Serialization.scala:45)
+plus runtime registration.
+
+TPU note: message payloads that are jax/numpy arrays use the tensor serializer
+(raw little-endian buffers + dtype/shape manifest) so remote tells of tensor
+blocks don't round-trip through pickle.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import pickle
+import struct
+import threading
+from dataclasses import is_dataclass, asdict
+from typing import Any, Dict, Optional, Tuple, Type
+
+import numpy as np
+
+
+class Serializer:
+    identifier: int = 0
+    include_manifest: bool = False
+
+    def manifest(self, obj: Any) -> str:
+        return ""
+
+    def to_binary(self, obj: Any) -> bytes:
+        raise NotImplementedError
+
+    def from_binary(self, data: bytes, manifest: str = "") -> Any:
+        raise NotImplementedError
+
+
+class PickleSerializer(Serializer):
+    """The default fallback (the reference's JavaSerializer analogue)."""
+
+    identifier = 1
+
+    def to_binary(self, obj: Any) -> bytes:
+        return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def from_binary(self, data: bytes, manifest: str = "") -> Any:
+        return pickle.loads(data)
+
+
+class StringSerializer(Serializer):
+    identifier = 2
+
+    def to_binary(self, obj: str) -> bytes:
+        return obj.encode("utf-8")
+
+    def from_binary(self, data: bytes, manifest: str = "") -> str:
+        return data.decode("utf-8")
+
+
+class BytesSerializer(Serializer):
+    identifier = 3
+
+    def to_binary(self, obj: bytes) -> bytes:
+        return bytes(obj)
+
+    def from_binary(self, data: bytes, manifest: str = "") -> bytes:
+        return data
+
+
+class JsonSerializer(Serializer):
+    """Dict/list/primitive JSON (the reference's akka-serialization-jackson
+    analogue for simple protocols)."""
+
+    identifier = 4
+
+    def to_binary(self, obj: Any) -> bytes:
+        if is_dataclass(obj) and not isinstance(obj, type):
+            obj = asdict(obj)
+        return json.dumps(obj, separators=(",", ":")).encode("utf-8")
+
+    def from_binary(self, data: bytes, manifest: str = "") -> Any:
+        return json.loads(data.decode("utf-8"))
+
+
+class TensorSerializer(Serializer):
+    """numpy / jax arrays as raw buffers; manifest = dtype|shape."""
+
+    identifier = 5
+    include_manifest = True
+
+    def manifest(self, obj: Any) -> str:
+        arr = np.asarray(obj)
+        return f"{arr.dtype.str}|{','.join(map(str, arr.shape))}"
+
+    def to_binary(self, obj: Any) -> bytes:
+        return np.ascontiguousarray(np.asarray(obj)).tobytes()
+
+    def from_binary(self, data: bytes, manifest: str = "") -> np.ndarray:
+        dtype_s, _, shape_s = manifest.partition("|")
+        shape = tuple(int(x) for x in shape_s.split(",") if x)
+        return np.frombuffer(data, dtype=np.dtype(dtype_s)).reshape(shape).copy()
+
+
+class SerializationError(Exception):
+    pass
+
+
+class Serialization:
+    """Per-system registry (reference: Serialization.scala:138)."""
+
+    def __init__(self, system=None):
+        self.system = system
+        self._by_id: Dict[int, Serializer] = {}
+        self._bindings: list[Tuple[type, Serializer]] = []
+        self._cache: Dict[type, Serializer] = {}
+        self._lock = threading.Lock()
+        for s in (PickleSerializer(), StringSerializer(), BytesSerializer(),
+                  JsonSerializer(), TensorSerializer()):
+            self.register_serializer(s)
+        self.add_binding(str, self._by_id[2])
+        self.add_binding(bytes, self._by_id[3])
+        self.add_binding(np.ndarray, self._by_id[5])
+        self.add_binding(object, self._by_id[1])  # fallback
+
+    def register_serializer(self, serializer: Serializer) -> None:
+        with self._lock:
+            existing = self._by_id.get(serializer.identifier)
+            if existing is not None and type(existing) is not type(serializer):
+                raise SerializationError(
+                    f"serializer id {serializer.identifier} already bound to "
+                    f"{type(existing).__name__}")
+            self._by_id[serializer.identifier] = serializer
+
+    def add_binding(self, cls: type, serializer: Serializer) -> None:
+        self.register_serializer(serializer)
+        with self._lock:
+            self._bindings.append((cls, serializer))
+            # most specific class first (reference: Serialization.bindings sort)
+            self._bindings.sort(key=lambda kv: -_depth(kv[0]))
+            self._cache.clear()
+
+    def find_serializer_for(self, obj: Any) -> Serializer:
+        cls = type(obj)
+        s = self._cache.get(cls)
+        if s is not None:
+            return s
+        for bound_cls, ser in self._bindings:
+            if isinstance(obj, bound_cls):
+                self._cache[cls] = ser
+                return ser
+        raise SerializationError(f"no serializer for {cls.__name__}")
+
+    def serializer_by_id(self, id_: int) -> Serializer:
+        s = self._by_id.get(id_)
+        if s is None:
+            raise SerializationError(f"unknown serializer id {id_}")
+        return s
+
+    # -- round trips ---------------------------------------------------------
+    def serialize(self, obj: Any) -> Tuple[int, str, bytes]:
+        s = self.find_serializer_for(obj)
+        return s.identifier, s.manifest(obj), s.to_binary(obj)
+
+    def deserialize(self, serializer_id: int, manifest: str, data: bytes) -> Any:
+        return self.serializer_by_id(serializer_id).from_binary(data, manifest)
+
+    def verify_round_trip(self, obj: Any) -> Any:
+        """The serialize-messages guard rail (reference:
+        actor/dungeon/Dispatch.scala:162-204)."""
+        sid, manifest, data = self.serialize(obj)
+        return self.deserialize(sid, manifest, data)
+
+
+def _depth(cls: type) -> int:
+    return len(cls.__mro__)

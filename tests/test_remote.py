@@ -1,0 +1,167 @@
+"""Remoting tests: two actor systems in one process over the in-proc
+transport (the multi-JVM specs' single-machine analogue, SURVEY.md §4.4)."""
+
+import threading
+import time
+
+import pytest
+
+from akka_tpu import Actor, ActorSystem, Props, Terminated, ask_sync
+from akka_tpu.remote.provider import AddressTerminated, QuarantinedEvent
+from akka_tpu.remote.transport import InProcTransport
+from akka_tpu.serialization.serialization import Serialization
+import numpy as np
+
+
+def remote_system(name: str, port: int = 0) -> ActorSystem:
+    return ActorSystem.create(name, {
+        "akka": {"actor": {"provider": "remote"},
+                 "stdout-loglevel": "OFF", "log-dead-letters": 0,
+                 "remote": {"transport": "inproc",
+                            "canonical": {"hostname": "local", "port": port}}}})
+
+
+@pytest.fixture()
+def two_systems():
+    InProcTransport.fault_injector.reset()
+    a = remote_system("sysA")
+    b = remote_system("sysB")
+    yield a, b
+    for s in (a, b):
+        s.terminate()
+    for s in (a, b):
+        assert s.await_termination(10.0)
+    InProcTransport.fault_injector.reset()
+
+
+class Echo(Actor):
+    def receive(self, message):
+        if message == "who":
+            self.sender.tell(str(self.context.system.name), self.self_ref)
+        else:
+            self.sender.tell(("echo", message), self.self_ref)
+
+
+def addr_of(system) -> str:
+    a = system.provider.local_address
+    return f"akka://{system.name}@{a.host}:{a.port}"
+
+
+def test_remote_tell_and_reply(two_systems):
+    a, b = two_systems
+    b.actor_of(Props.create(Echo), "echo")
+    time.sleep(0.1)
+    remote_echo = a.provider.resolve_actor_ref(f"{addr_of(b)}/user/echo")
+    assert remote_echo is not a.dead_letters
+    assert ask_sync(remote_echo, "who", timeout=5.0, system=a) == "sysB"
+    assert ask_sync(remote_echo, ("x", 1), timeout=5.0, system=a) == ("echo", ("x", 1))
+
+
+def test_remote_tensor_payload(two_systems):
+    a, b = two_systems
+    results = []
+    got = threading.Event()
+
+    class TensorSink(Actor):
+        def receive(self, message):
+            results.append(message)
+            got.set()
+
+    b.actor_of(Props.create(TensorSink), "sink")
+    time.sleep(0.1)
+    sink = a.provider.resolve_actor_ref(f"{addr_of(b)}/user/sink")
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    sink.tell(arr)
+    assert got.wait(5.0)
+    np.testing.assert_array_equal(results[0], arr)
+
+
+def test_remote_stop(two_systems):
+    a, b = two_systems
+    echo = b.actor_of(Props.create(Echo), "victim")
+    time.sleep(0.1)
+    remote = a.provider.resolve_actor_ref(f"{addr_of(b)}/user/victim")
+    remote.stop()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not echo.is_terminated:
+        time.sleep(0.02)
+    assert echo.is_terminated
+
+
+def test_blackhole_drops_messages(two_systems):
+    a, b = two_systems
+    received = []
+
+    class Sink(Actor):
+        def receive(self, message):
+            received.append(message)
+
+    b.actor_of(Props.create(Sink), "sink")
+    time.sleep(0.1)
+    sink = a.provider.resolve_actor_ref(f"{addr_of(b)}/user/sink")
+    sink.tell("before")
+    time.sleep(0.2)
+    a_addr = f"{a.provider.local_address.host}:{a.provider.local_address.port}"
+    b_addr = f"{b.provider.local_address.host}:{b.provider.local_address.port}"
+    InProcTransport.fault_injector.blackhole(a_addr, b_addr)
+    sink.tell("dropped")
+    time.sleep(0.2)
+    InProcTransport.fault_injector.pass_through(a_addr, b_addr)
+    sink.tell("after")
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and "after" not in received:
+        time.sleep(0.02)
+    assert received == ["before", "after"]
+
+
+def test_quarantine_blocks_traffic(two_systems):
+    a, b = two_systems
+    b.actor_of(Props.create(Echo), "echo")
+    time.sleep(0.1)
+    remote = a.provider.resolve_actor_ref(f"{addr_of(b)}/user/echo")
+    assert ask_sync(remote, "who", timeout=5.0, system=a) == "sysB"
+    events = []
+    a.event_stream.subscribe(lambda e: events.append(e), QuarantinedEvent)
+    assoc = a.provider._association(b.provider.local_address)
+    a.provider.quarantine(b.provider.local_address, assoc.peer_uid)
+    with pytest.raises(Exception):
+        ask_sync(remote, "who", timeout=0.5, system=a)
+    assert events and isinstance(events[0], QuarantinedEvent)
+
+
+def test_serialization_round_trips():
+    s = Serialization()
+    for obj in ["hello", b"raw", {"k": [1, 2, 3]}, ("tuple", 1), 42,
+                np.arange(6, dtype=np.int32).reshape(2, 3)]:
+        out = s.verify_round_trip(obj)
+        if isinstance(obj, np.ndarray):
+            np.testing.assert_array_equal(out, obj)
+        elif isinstance(obj, dict):
+            assert out == obj
+        else:
+            assert out == obj or out == list(obj)  # json tuples -> lists
+
+
+def test_serializer_binding_most_specific_wins():
+    from akka_tpu.serialization.serialization import (JsonSerializer,
+                                                      Serialization, Serializer)
+
+    class MyMsg(dict):
+        pass
+
+    class MySerializer(Serializer):
+        identifier = 99
+
+        def to_binary(self, obj):
+            return b"custom"
+
+        def from_binary(self, data, manifest=""):
+            return MyMsg(marker=True)
+
+    s = Serialization()
+    s.add_binding(MyMsg, MySerializer())
+    sid, _, data = s.serialize(MyMsg(a=1))
+    assert sid == 99 and data == b"custom"
+    # plain dicts still use pickle fallback
+    sid2, _, _ = s.serialize({"a": 1})
+    assert sid2 != 99
