@@ -495,13 +495,29 @@ class ActorCell:
                 self._finish_recreate(self._pending_recreate_cause)
             elif self._terminating and not self._children:
                 self._finish_terminate()
-        if actor in self._watching:
-            custom = self._watching.pop(actor)
+        watched_key = self._find_watched(actor)
+        if watched_key is not None:
+            custom = self._watching.pop(watched_key)
             if not self._terminating and not self._terminated:
                 message = custom if custom is not None else Terminated(
-                    actor, existence_confirmed, address_terminated, cause)
+                    watched_key, existence_confirmed, address_terminated, cause)
                 # delivered as a normal user message, bypassing the closed check
-                self._invoke_terminated(Envelope(message, actor))
+                self._invoke_terminated(Envelope(message, watched_key))
+
+    def _find_watched(self, actor: ActorRef) -> Optional[ActorRef]:
+        """Exact (path+uid) match first; else a path match where either side
+        lacks a uid — a remote watch resolved without uid must still match the
+        uid-carrying ref inside an inbound DeathWatchNotification."""
+        if actor in self._watching:
+            return actor
+        from .path import undefined_uid
+        for key in self._watching:
+            if key.path == actor.path and (
+                    key.path.uid == undefined_uid
+                    or actor.path.uid == undefined_uid
+                    or key.path.uid == actor.path.uid):
+                return key
+        return None
 
     def _invoke_terminated(self, envelope: Envelope) -> None:
         # Terminated must reach the actor even while mailbox is suspended;

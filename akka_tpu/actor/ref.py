@@ -50,6 +50,13 @@ class ActorRef:
     def __repr__(self) -> str:
         return f"Actor[{self.path.to_serialization_format()}]"
 
+    def __reduce__(self):
+        # refs in message payloads cross the wire as full-address path strings
+        # resolved against the receiving system's provider (reference:
+        # Serialization.currentTransportInformation, Serialization.scala:93-136)
+        from ..serialization.serialization import resolve_ref, serialized_ref_path
+        return (resolve_ref, (serialized_ref_path(self),))
+
 
 class InternalActorRef(ActorRef):
     """SPI shared by local/remote refs (reference: InternalActorRef in ActorRef.scala)."""

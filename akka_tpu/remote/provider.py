@@ -24,7 +24,7 @@ from ..actor.props import Props
 from ..actor.provider import LocalActorRefProvider
 from ..actor.ref import ActorRef, InternalActorRef
 from ..dispatch import sysmsg
-from ..serialization.serialization import Serialization
+from ..serialization.serialization import Serialization, transport_information
 from .failure_detector import FailureDetectorRegistry, PhiAccrualFailureDetector
 from .transport import InProcTransport, TcpTransport, Transport, WireEnvelope
 
@@ -58,9 +58,14 @@ class RemoteActorRef(InternalActorRef):
 
     def send_system_message(self, message: sysmsg.SystemMessage) -> None:
         if isinstance(message, sysmsg.Watch):
+            # node-level: heartbeat the address (RemoteWatcher.scala:34-88);
+            # actor-level: forward Watch so the watchee's cell registers the
+            # remote watcher and emits DeathWatchNotification on normal stop
             self.provider.remote_watcher_watch(message.watchee, message.watcher)
+            self.provider.remote_send(self, message, None, is_system=True)
         elif isinstance(message, sysmsg.Unwatch):
             self.provider.remote_watcher_unwatch(message.watchee, message.watcher)
+            self.provider.remote_send(self, message, None, is_system=True)
         elif isinstance(message, sysmsg.Terminate):
             # remote stop: deliver PoisonPill-ish via system channel
             self.provider.remote_send(self, _RemoteTerminate(), None, is_system=True)
@@ -248,7 +253,8 @@ class RemoteActorRefProvider(LocalActorRefProvider):
         if assoc.peer_uid is not None and assoc.is_quarantined(assoc.peer_uid):
             self.dead_letters.tell(DeadLetter(message, sender, ref), sender)
             return
-        sid, manifest, payload = self.serialization.serialize(message)
+        with transport_information(self):
+            sid, manifest, payload = self.serialization.serialize(message)
         sender_path = None
         if sender is not None:
             sp = sender.path
@@ -271,7 +277,8 @@ class RemoteActorRefProvider(LocalActorRefProvider):
             self.dead_letters.tell(DeadLetter(message, sender, ref), sender)
 
     def send_control(self, addr: Address, message: Any) -> None:
-        sid, manifest, payload = self.serialization.serialize(message)
+        with transport_information(self):
+            sid, manifest, payload = self.serialization.serialize(message)
         env = WireEnvelope(
             recipient=f"{addr}/system/remote-watcher",
             sender=None, serializer_id=sid, manifest=manifest, payload=payload,
@@ -296,6 +303,7 @@ class RemoteActorRefProvider(LocalActorRefProvider):
 
     def _handle_inbound(self, env: WireEnvelope) -> None:
         from_addr = Address.parse(env.from_address) if env.from_address else None
+        ack_after_delivery = None
         if from_addr is not None:
             assoc = self._association(from_addr)
             if assoc.is_quarantined(env.from_uid):
@@ -313,8 +321,9 @@ class RemoteActorRefProvider(LocalActorRefProvider):
                     if env.seq <= assoc.last_delivered_seq:
                         self._send_ack(from_addr, assoc)
                         return  # duplicate
-                    assoc.last_delivered_seq = env.seq
-                self._send_ack(from_addr, assoc)
+                # ack only AFTER successful deserialize+delivery, so a failed
+                # delivery is resent rather than silently acked away
+                ack_after_delivery = (from_addr, assoc, env.seq)
             if env.ack is not None:
                 with assoc.lock:
                     for s in [s for s in assoc.pending_acks if s <= env.ack]:
@@ -322,8 +331,9 @@ class RemoteActorRefProvider(LocalActorRefProvider):
                 if env.serializer_id == -1:
                     return  # pure ack
 
-        message = self.serialization.deserialize(env.serializer_id, env.manifest,
-                                                 env.payload)
+        with transport_information(self):
+            message = self.serialization.deserialize(env.serializer_id, env.manifest,
+                                                     env.payload)
         # control-plane messages
         if isinstance(message, _Heartbeat):
             addr = Address.parse(message.from_address)
@@ -340,12 +350,16 @@ class RemoteActorRefProvider(LocalActorRefProvider):
         if isinstance(message, _RemoteTerminate):
             if isinstance(recipient, InternalActorRef):
                 recipient.stop()
-            return
-        if env.is_system and isinstance(message, sysmsg.SystemMessage):
+        elif env.is_system and isinstance(message, sysmsg.SystemMessage):
             if isinstance(recipient, InternalActorRef):
                 recipient.send_system_message(message)
-            return
-        recipient.tell(message, sender)
+        else:
+            recipient.tell(message, sender)
+        if ack_after_delivery is not None:
+            addr, assoc, seq = ack_after_delivery
+            with assoc.lock:
+                assoc.last_delivered_seq = max(assoc.last_delivered_seq, seq)
+            self._send_ack(addr, assoc)
 
     def _send_ack(self, addr: Address, assoc: Association) -> None:
         env = WireEnvelope(recipient="", sender=None, serializer_id=-1,

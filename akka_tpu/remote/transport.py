@@ -127,9 +127,10 @@ class InProcTransport(Transport):
     _port_counter = [20000]
     fault_injector = FaultInjector()
 
+    _registry_queues: Dict[Tuple[str, int], "queue.Queue[Optional[WireEnvelope]]"] = {}
+
     def __init__(self, local_address: str = ""):
         self.local_address = local_address
-        self._executor = None
         self._bound: Optional[Tuple[str, int]] = None
 
     def listen(self, host: str, port: int, handler: InboundHandler) -> Tuple[str, int]:
@@ -137,25 +138,46 @@ class InProcTransport(Transport):
             if port == 0:
                 self._port_counter[0] += 1
                 port = self._port_counter[0]
+            if (host, port) in self._registry:
+                raise OSError(f"inproc address {host}:{port} already bound")
             self._registry[(host, port)] = handler
             self._bound = (host, port)
+            # one delivery queue + worker per listener: FIFO per link, async
+            # w.r.t. the sender (like a real socket's receive path)
+            q: "queue.Queue[Optional[WireEnvelope]]" = queue.Queue()
+            self._registry_queues[(host, port)] = q
+
+            def _drain():
+                while True:
+                    env = q.get()
+                    if env is None:
+                        return
+                    try:
+                        handler(env)
+                    except Exception:  # noqa: BLE001 — bad frame must not kill the loop
+                        pass
+
+            threading.Thread(target=_drain, daemon=True,
+                             name=f"akka-tpu-inproc-{host}:{port}").start()
         return host, port
 
     def send(self, host: str, port: int, envelope: WireEnvelope) -> bool:
-        handler = self._registry.get((host, port))
-        if handler is None:
+        q = self._registry_queues.get((host, port))
+        if q is None:
             return False
         to_addr = f"{host}:{port}"
         if not self.fault_injector.allow(self.local_address, to_addr):
             return False
-        # deliver on a fresh stack to mimic network asynchrony
-        threading.Thread(target=handler, args=(envelope,), daemon=True).start()
+        q.put(envelope)
         return True
 
     def shutdown(self) -> None:
         with self._reg_lock:
             if self._bound is not None:
                 self._registry.pop(self._bound, None)
+                q = self._registry_queues.pop(self._bound, None)
+                if q is not None:
+                    q.put(None)
 
 
 class TcpTransport(Transport):
@@ -166,6 +188,7 @@ class TcpTransport(Transport):
         self.local_address = local_address
         self._server_sock: Optional[socket.socket] = None
         self._conns: Dict[Tuple[str, int], socket.socket] = {}
+        self._peer_locks: Dict[Tuple[str, int], threading.Lock] = {}
         self._conn_lock = threading.Lock()
         self._stop = threading.Event()
         self.fault_injector = FaultInjector()
@@ -214,24 +237,36 @@ class TcpTransport(Transport):
         finally:
             conn.close()
 
+    def _peer_lock(self, key: Tuple[str, int]) -> threading.Lock:
+        # per-peer lock so a slow/blocked connect to one peer doesn't stall
+        # sends (e.g. failure-detector heartbeats) to healthy peers
+        with self._conn_lock:
+            lock = self._peer_locks.get(key)
+            if lock is None:
+                lock = self._peer_locks[key] = threading.Lock()
+            return lock
+
     def send(self, host: str, port: int, envelope: WireEnvelope) -> bool:
         if not self.fault_injector.allow(self.local_address, f"{host}:{port}"):
             return False
         data = envelope.to_bytes()
         frame = _LEN.pack(len(data)) + data
-        with self._conn_lock:
-            sock = self._conns.get((host, port))
+        key = (host, port)
+        with self._peer_lock(key):
+            sock = self._conns.get(key)
             if sock is None:
                 try:
                     sock = socket.create_connection((host, port), timeout=5.0)
                 except OSError:
                     return False
-                self._conns[(host, port)] = sock
+                with self._conn_lock:
+                    self._conns[key] = sock
             try:
                 sock.sendall(frame)
                 return True
             except OSError:
-                self._conns.pop((host, port), None)
+                with self._conn_lock:
+                    self._conns.pop(key, None)
                 try:
                     sock.close()
                 except OSError:

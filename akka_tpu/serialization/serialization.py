@@ -109,6 +109,53 @@ class SerializationError(Exception):
     pass
 
 
+# -- ActorRef transparency over the wire -------------------------------------
+# (reference: Serialization.currentTransportInformation thread-local,
+# Serialization.scala:93-136 — refs serialize as full-address path strings and
+# resolve against the current system's provider on the receiving side)
+
+_transport_info = threading.local()
+
+
+class transport_information:
+    """Context manager installing the provider used to (de)serialize ActorRefs
+    embedded in message payloads."""
+
+    def __init__(self, provider):
+        self.provider = provider
+
+    def __enter__(self):
+        self._prev = getattr(_transport_info, "provider", None)
+        _transport_info.provider = self.provider
+        return self
+
+    def __exit__(self, *exc):
+        _transport_info.provider = self._prev
+
+
+def serialized_ref_path(ref) -> str:
+    """Full-address serialization path for a ref (local addresses get the
+    provider's canonical host:port)."""
+    provider = getattr(_transport_info, "provider", None)
+    path = ref.path
+    if provider is None:
+        raise SerializationError(
+            f"cannot serialize ActorRef {path}: no transport information set "
+            "(refs only cross the wire inside remote-enabled systems)")
+    local = getattr(provider, "local_address", None)
+    if local is not None and path.address.has_local_scope:
+        path = path.with_address(local)
+    return path.to_serialization_format()
+
+
+def resolve_ref(path: str):
+    provider = getattr(_transport_info, "provider", None)
+    if provider is None:
+        raise SerializationError(
+            f"cannot deserialize ActorRef {path}: no transport information set")
+    return provider.resolve_actor_ref(path)
+
+
 class Serialization:
     """Per-system registry (reference: Serialization.scala:138)."""
 
@@ -124,6 +171,11 @@ class Serialization:
         self.add_binding(str, self._by_id[2])
         self.add_binding(bytes, self._by_id[3])
         self.add_binding(np.ndarray, self._by_id[5])
+        try:  # jax.Array is not an np.ndarray; bind it to the tensor path too
+            import jax
+            self.add_binding(jax.Array, self._by_id[5])
+        except Exception:  # noqa: BLE001 — jax optional for the host runtime
+            pass
         self.add_binding(object, self._by_id[1])  # fallback
 
     def register_serializer(self, serializer: Serializer) -> None:
@@ -148,10 +200,11 @@ class Serialization:
         s = self._cache.get(cls)
         if s is not None:
             return s
-        for bound_cls, ser in self._bindings:
-            if isinstance(obj, bound_cls):
-                self._cache[cls] = ser
-                return ser
+        with self._lock:
+            for bound_cls, ser in self._bindings:
+                if isinstance(obj, bound_cls):
+                    self._cache[cls] = ser
+                    return ser
         raise SerializationError(f"no serializer for {cls.__name__}")
 
     def serializer_by_id(self, id_: int) -> Serializer:

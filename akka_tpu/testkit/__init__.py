@@ -1,0 +1,14 @@
+"""Test infrastructure (reference: akka-testkit, akka-actor-testkit-typed,
+akka-multi-node-testkit — SURVEY.md §4)."""
+
+from .probe import (TestProbe, AssertionFailure, await_assert,  # noqa: F401
+                    await_condition)
+from .behavior_testkit import (BehaviorTestKit, TestInbox, Effect,  # noqa: F401
+                               Spawned, SpawnedAnonymous, Stopped, Watched,
+                               WatchedWith, Unwatched, Scheduled,
+                               ReceiveTimeoutSet, ReceiveTimeoutCancelled,
+                               MessageAdapter)
+from .manual_time import ManualTimeScheduler, install_manual_time  # noqa: F401
+from .event_filter import LoggingTestKit  # noqa: F401
+from .multi_node import (MultiNodeKit, NodeHandle, TestConductor,  # noqa: F401
+                         BarrierTimeout)

@@ -165,3 +165,43 @@ def test_serializer_binding_most_specific_wins():
     # plain dicts still use pickle fallback
     sid2, _, _ = s.serialize({"a": 1})
     assert sid2 != 99
+
+
+def test_remote_watch_actor_level_graceful_stop(two_systems):
+    """Watching a remote actor must produce Terminated when the actor stops
+    normally while its node stays up (actor-level deathwatch, not just
+    node-level; reference: RemoteWatcher + remote DeathWatchNotification)."""
+    from akka_tpu import PoisonPill
+    from akka_tpu.testkit import TestProbe
+
+    a, b = two_systems
+    target = b.actor_of(Props.create(Echo), "target")
+    time.sleep(0.1)
+    remote = a.provider.resolve_actor_ref(f"{addr_of(b)}/user/target")
+    probe = TestProbe(a)
+    probe.watch(remote)
+    time.sleep(0.2)  # let the Watch reach node b
+    target.tell(PoisonPill)
+    t = probe.expect_msg_class(Terminated, timeout=5.0)
+    assert t.actor.path.elements == ("user", "target")
+
+
+def test_remote_refs_inside_payloads(two_systems):
+    """ActorRefs embedded in message payloads must survive the wire and be
+    tell-able on the other side (reference: Serialization transport info)."""
+    from akka_tpu.testkit import TestProbe
+
+    a, b = two_systems
+
+    class ReplyToInner(Actor):
+        def receive(self, message):
+            # message is ("reply-to", some_ref): answer that ref, not sender
+            tag, ref = message
+            ref.tell(("from", str(self.context.system.name)), self.self_ref)
+
+    b.actor_of(Props.create(ReplyToInner), "inner")
+    time.sleep(0.1)
+    remote = a.provider.resolve_actor_ref(f"{addr_of(b)}/user/inner")
+    probe = TestProbe(a)
+    remote.tell(("reply-to", probe.ref))
+    assert probe.receive_one(5.0) == ("from", "sysB")

@@ -1,0 +1,203 @@
+"""Testkit tests — modeled on the reference's own testkit specs
+(BehaviorTestKitSpec, TestProbeSpec, MultiNodeSpec usage; SURVEY.md §4)."""
+
+import time
+
+import pytest
+
+from akka_tpu import Actor, ActorSystem, Props, PoisonPill
+from akka_tpu.testkit import (BehaviorTestKit, LoggingTestKit, MultiNodeKit,
+                              Scheduled, Spawned, TestInbox, TestProbe,
+                              AssertionFailure, await_assert, install_manual_time)
+from akka_tpu.typed.behaviors import Behaviors
+
+
+@pytest.fixture()
+def system():
+    sys = ActorSystem.create("testkit", {"akka": {"stdout-loglevel": "ERROR",
+                                                  "log-dead-letters": 0}})
+    yield sys
+    sys.terminate()
+    assert sys.await_termination(10.0)
+
+
+class Echo(Actor):
+    def receive(self, message):
+        self.sender.tell(message, self.self_ref)
+
+
+# -- TestProbe ---------------------------------------------------------------
+
+def test_probe_expect_msg(system):
+    probe = TestProbe(system)
+    echo = system.actor_of(Props.create(Echo))
+    probe.send(echo, "ping")
+    assert probe.expect_msg("ping") == "ping"
+    assert probe.last_sender == echo
+
+
+def test_probe_reply(system):
+    probe = TestProbe(system)
+    echo = system.actor_of(Props.create(Echo))
+    probe.send(echo, "hi")
+    probe.expect_msg("hi")
+    probe.reply("back")  # echo will echo it back to the probe
+    probe.expect_msg("back")
+
+
+def test_probe_expect_no_message(system):
+    probe = TestProbe(system)
+    probe.expect_no_message(0.1)
+    probe.ref.tell("x")
+    with pytest.raises(AssertionFailure):
+        probe.expect_no_message(0.3)
+
+
+def test_probe_expect_terminated(system):
+    probe = TestProbe(system)
+    echo = system.actor_of(Props.create(Echo))
+    probe.watch(echo)
+    echo.tell(PoisonPill)
+    t = probe.expect_terminated(echo)
+    assert t.actor == echo
+
+
+def test_probe_fish_for_message(system):
+    probe = TestProbe(system)
+    for i in range(5):
+        probe.ref.tell(i)
+    assert probe.fish_for_message(lambda m: m == 3) == 3
+
+
+def test_await_assert():
+    state = {"n": 0}
+
+    def bump():
+        state["n"] += 1
+        assert state["n"] >= 3
+    await_assert(bump, max_time=2.0, interval=0.01)
+
+
+# -- BehaviorTestKit ---------------------------------------------------------
+
+def test_behavior_testkit_spawn_effect():
+    child = Behaviors.receive_message(lambda m: Behaviors.same)
+
+    def on_msg(ctx, msg):
+        ctx.spawn(child, "worker")
+        return Behaviors.same
+
+    kit = BehaviorTestKit(Behaviors.receive(on_msg))
+    kit.run("go")
+    eff = kit.expect_effect_class(Spawned)
+    assert eff.child_name == "worker"
+
+
+def test_behavior_testkit_child_inbox():
+    child = Behaviors.receive_message(lambda m: Behaviors.same)
+
+    def on_msg(ctx, msg):
+        ref = ctx.spawn(child, "kid")
+        ref.tell(("hello", msg))
+        return Behaviors.same
+
+    kit = BehaviorTestKit(Behaviors.receive(on_msg))
+    kit.run(42)
+    kit.retrieve_all_effects()
+    assert kit.child_inbox("kid").receive_message() == ("hello", 42)
+
+
+def test_behavior_testkit_timers_effect():
+    def factory(timers):
+        def on_msg(ctx, msg):
+            timers.start_single_timer("k", "tick", 1.5)
+            return Behaviors.same
+        return Behaviors.receive(on_msg)
+
+    kit = BehaviorTestKit(Behaviors.with_timers(factory))
+    kit.run("arm")
+    eff = kit.expect_effect_class(Scheduled)
+    assert eff.message == "tick" and eff.delay == 1.5
+
+
+def test_behavior_testkit_stop():
+    def on_msg(ctx, msg):
+        if msg == "die":
+            return Behaviors.stopped()
+        return Behaviors.same
+
+    kit = BehaviorTestKit(Behaviors.receive(on_msg))
+    assert kit.is_alive
+    kit.run("die")
+    assert not kit.is_alive
+
+
+def test_test_inbox():
+    inbox = TestInbox("box")
+    inbox.ref.tell("a")
+    inbox.ref.tell("b")
+    assert inbox.expect_message("a") == "a"
+    assert inbox.receive_message() == "b"
+    assert not inbox.has_messages
+
+
+# -- ManualTime --------------------------------------------------------------
+
+def test_manual_time(system):
+    manual = install_manual_time(system)
+    probe = TestProbe(system)
+    system.scheduler.schedule_tell_once(5.0, probe.ref, "later")
+    probe.expect_no_message(0.1)
+    manual.time_passes(4.0)
+    probe.expect_no_message(0.1)
+    manual.time_passes(2.0)
+    probe.expect_msg("later")
+
+
+# -- LoggingTestKit ----------------------------------------------------------
+
+def test_logging_testkit(system):
+    with LoggingTestKit.warn("something odd").expect(system):
+        system.log.warning("something odd happened")
+
+
+# -- MultiNodeKit ------------------------------------------------------------
+
+def test_multi_node_barrier_and_messaging():
+    with MultiNodeKit(["first", "second"]) as kit:
+        out = {}
+
+        def first(node):
+            probe = TestProbe(node.system)
+            node.system.actor_of(Props.create(Echo), "echo")
+            node.enter_barrier("deployed")
+            node.enter_barrier("done")
+
+        def second(node):
+            node.enter_barrier("deployed")
+            probe = TestProbe(node.system)
+            remote = node.system.provider.resolve_actor_ref(
+                kit.node("first", "/user/echo"))
+            probe.send(remote, "over-the-wire")
+            out["reply"] = probe.receive_one(5.0)
+            node.enter_barrier("done")
+
+        kit.run({"first": first, "second": second})
+        assert out["reply"] == "over-the-wire"
+
+
+def test_multi_node_blackhole():
+    with MultiNodeKit(["a", "b"]) as kit:
+        kit.system("a").actor_of(Props.create(Echo), "echo")
+        time.sleep(0.1)
+        probe = TestProbe(kit.system("b"))
+        remote = kit.system("b").provider.resolve_actor_ref(
+            kit.node("a", "/user/echo"))
+        probe.send(remote, "one")
+        probe.expect_msg("one", timeout=5.0)
+        kit.conductor.blackhole("a", "b")
+        probe.send(remote, "two")
+        probe.expect_no_message(0.4)
+        kit.conductor.pass_through("a", "b")
+        probe.send(remote, "three")
+        probe.expect_msg("three", timeout=5.0)
