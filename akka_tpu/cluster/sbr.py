@@ -1,0 +1,172 @@
+"""Split-brain resolver: downing strategies applied after a stable period.
+
+Reference parity: akka-cluster/src/main/scala/akka/cluster/sbr/
+SplitBrainResolver.scala (:96 actor, :134 stable-after logic, :536 strategy
+selection) and sbr/DowningStrategy.scala — keep-majority, static-quorum,
+keep-oldest, down-all. A side that decides it lost downs ITSELF (both sides
+decide independently and deterministically, so exactly one survives).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, FrozenSet, List, Optional, Set
+
+from ..actor.actor import Actor
+from .events import (ClusterDomainEvent, MemberEvent, ReachabilityEvent,
+                     ReachableMember, UnreachableMember)
+from .member import Member, MemberStatus, UniqueAddress
+
+_CONSIDERED = {MemberStatus.UP, MemberStatus.LEAVING, MemberStatus.EXITING}
+
+
+@dataclass
+class Decision:
+    down_nodes: List[UniqueAddress]
+
+
+class DowningStrategy:
+    """`decide` sees members (considered statuses only), the unreachable set,
+    and this node; returns the nodes THIS side should down."""
+
+    def decide(self, members: List[Member], unreachable: Set[UniqueAddress],
+               self_node: UniqueAddress) -> Decision:
+        raise NotImplementedError
+
+    @staticmethod
+    def _sides(members, unreachable):
+        reachable = [m for m in members if m.unique_address not in unreachable]
+        lost = [m for m in members if m.unique_address in unreachable]
+        return reachable, lost
+
+    @staticmethod
+    def _down_side(side) -> Decision:
+        return Decision([m.unique_address for m in side])
+
+
+class KeepMajority(DowningStrategy):
+    """(reference: DowningStrategy.KeepMajority — ties broken by lowest
+    address, which is deterministic on both sides)"""
+
+    def decide(self, members, unreachable, self_node):
+        reachable, lost = self._sides(members, unreachable)
+        if not lost:
+            return Decision([])
+        if len(reachable) > len(lost):
+            return self._down_side(lost)
+        if len(reachable) < len(lost):
+            return self._down_side(reachable)  # we lost; down our own side
+        # tie: the side holding the lowest address survives
+        lowest = min(m.unique_address for m in members)
+        if any(m.unique_address == lowest for m in reachable):
+            return self._down_side(lost)
+        return self._down_side(reachable)
+
+
+class StaticQuorum(DowningStrategy):
+    def __init__(self, quorum_size: int):
+        self.quorum_size = quorum_size
+
+    def decide(self, members, unreachable, self_node):
+        reachable, lost = self._sides(members, unreachable)
+        if not lost:
+            return Decision([])
+        if len(reachable) >= self.quorum_size:
+            return self._down_side(lost)
+        return self._down_side(reachable)
+
+
+class KeepOldest(DowningStrategy):
+    def __init__(self, down_if_alone: bool = True):
+        self.down_if_alone = down_if_alone
+
+    def decide(self, members, unreachable, self_node):
+        reachable, lost = self._sides(members, unreachable)
+        if not lost or not members:
+            return Decision([])
+        oldest = min(members, key=lambda m: (m.up_number, m.unique_address))
+        oldest_is_here = any(m.unique_address == oldest.unique_address
+                             for m in reachable)
+        if oldest_is_here:
+            if self.down_if_alone and len(reachable) == 1 and len(lost) >= 1:
+                return self._down_side(reachable)  # oldest alone: sacrifice it
+            return self._down_side(lost)
+        return self._down_side(reachable)
+
+
+class DownAll(DowningStrategy):
+    def decide(self, members, unreachable, self_node):
+        return Decision([m.unique_address for m in members])
+
+
+def strategy_from_config(cfg) -> DowningStrategy:
+    name = cfg.get_string("active-strategy", "keep-majority")
+    if name == "keep-majority":
+        return KeepMajority()
+    if name == "static-quorum":
+        return StaticQuorum(cfg.get_int("static-quorum.quorum-size", 1))
+    if name == "keep-oldest":
+        return KeepOldest(cfg.get_bool("keep-oldest.down-if-alone", True))
+    if name == "down-all":
+        return DownAll()
+    raise ValueError(f"unknown split-brain-resolver strategy {name!r}")
+
+
+class SplitBrainResolver(Actor):
+    """Subscribes to reachability events; after `stable_after` seconds of an
+    unchanged unreachable set, applies the strategy and downs the losers."""
+
+    class _Tick:
+        pass
+
+    def __init__(self, cluster, strategy: DowningStrategy, stable_after: float,
+                 tick_interval: float = 0.25):
+        super().__init__()
+        self.cluster = cluster
+        self.strategy = strategy
+        self.stable_after = stable_after
+        self.tick_interval = tick_interval
+        self._unreachable: Set[UniqueAddress] = set()
+        self._deadline: Optional[float] = None
+        self._task = None
+
+    def pre_start(self) -> None:
+        self._sub = lambda e: self.self_ref.tell(e)
+        self.context.system.event_stream.subscribe(self._sub, ReachabilityEvent)
+        self._task = self.context.system.scheduler.schedule_tell_with_fixed_delay(
+            self.tick_interval, self.tick_interval, self.self_ref, self._Tick())
+
+    def post_stop(self) -> None:
+        self.context.system.event_stream.unsubscribe(self._sub)
+        if self._task is not None:
+            self._task.cancel()
+
+    def receive(self, message: Any):
+        if isinstance(message, UnreachableMember):
+            self._unreachable.add(message.member.unique_address)
+            self._deadline = time.monotonic() + self.stable_after
+        elif isinstance(message, ReachableMember):
+            self._unreachable.discard(message.member.unique_address)
+            self._deadline = (time.monotonic() + self.stable_after
+                              if self._unreachable else None)
+        elif isinstance(message, self._Tick):
+            if (self._deadline is not None and self._unreachable
+                    and time.monotonic() >= self._deadline):
+                self._act()
+        else:
+            return NotImplemented
+        return None
+
+    def _act(self) -> None:
+        state = self.cluster.state
+        members = [m for m in state.members if m.status in _CONSIDERED]
+        if not members:
+            self._deadline = None
+            return
+        decision = self.strategy.decide(
+            members, set(self._unreachable), self.cluster.self_unique_address)
+        for node in decision.down_nodes:
+            self.cluster.down(node.address_str)
+        self._deadline = None
+        self._unreachable -= set(decision.down_nodes)

@@ -132,6 +132,7 @@ class InProcTransport(Transport):
     def __init__(self, local_address: str = ""):
         self.local_address = local_address
         self._bound: Optional[Tuple[str, int]] = None
+        self._down = False
 
     def listen(self, host: str, port: int, handler: InboundHandler) -> Tuple[str, int]:
         with self._reg_lock:
@@ -162,6 +163,8 @@ class InProcTransport(Transport):
         return host, port
 
     def send(self, host: str, port: int, envelope: WireEnvelope) -> bool:
+        if self._down:  # a dead process sends nothing
+            return False
         q = self._registry_queues.get((host, port))
         if q is None:
             return False
@@ -172,6 +175,7 @@ class InProcTransport(Transport):
         return True
 
     def shutdown(self) -> None:
+        self._down = True
         with self._reg_lock:
             if self._bound is not None:
                 self._registry.pop(self._bound, None)

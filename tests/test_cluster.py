@@ -1,0 +1,190 @@
+"""Cluster membership tests — modeled on the reference multi-jvm specs
+(akka-cluster/src/multi-jvm: JoinSeedNodeSpec, LeavingSpec, SplitBrainSpec,
+convergence specs; SURVEY.md §4.4) and VectorClockSpec / GossipSpec unit
+suites, run over the in-proc transport."""
+
+import time
+
+import pytest
+
+from akka_tpu import ActorSystem
+from akka_tpu.cluster import (Cluster, Gossip, KeepMajority, Member,
+                              MemberStatus, MemberUp, Ordering, Reachability,
+                              StaticQuorum, UniqueAddress, VectorClock)
+from akka_tpu.remote.transport import InProcTransport
+from akka_tpu.testkit import await_condition
+
+
+# -- vector clock (reference: VectorClockSpec) --------------------------------
+
+def test_vector_clock_ordering():
+    a = VectorClock().bump("n1")
+    b = a.bump("n2")
+    assert a.compare(b) is Ordering.BEFORE
+    assert b.compare(a) is Ordering.AFTER
+    assert a.compare(a.merge(a)) is Ordering.SAME
+    c1 = a.bump("n1")
+    c2 = a.bump("n2")
+    assert c1.compare(c2) is Ordering.CONCURRENT
+    merged = c1.merge(c2)
+    assert c1.compare(merged) is Ordering.BEFORE
+    assert c2.compare(merged) is Ordering.BEFORE
+
+
+def test_member_transitions():
+    n = UniqueAddress("akka://s@h:1", 1)
+    m = Member(n, MemberStatus.JOINING)
+    m = m.copy_with(MemberStatus.UP, up_number=1)
+    m = m.copy_with(MemberStatus.LEAVING)
+    m = m.copy_with(MemberStatus.EXITING)
+    m = m.copy_with(MemberStatus.REMOVED)
+    with pytest.raises(ValueError):
+        Member(n, MemberStatus.UP).copy_with(MemberStatus.JOINING)
+
+
+def test_gossip_merge_prefers_later_status():
+    n1 = UniqueAddress("akka://s@h:1", 1)
+    n2 = UniqueAddress("akka://s@h:2", 2)
+    g1 = (Gossip().with_member(Member(n1, MemberStatus.UP, up_number=1))
+          .with_member(Member(n2, MemberStatus.JOINING)).bump(n1))
+    g2 = g1.with_member(Member(n2, MemberStatus.UP, up_number=2)).bump(n2)
+    merged = g1.merge(g2)
+    assert merged.member(n2).status is MemberStatus.UP
+
+
+def test_reachability_table():
+    n1 = UniqueAddress("akka://s@h:1", 1)
+    n2 = UniqueAddress("akka://s@h:2", 2)
+    r = Reachability().unreachable(n1, n2)
+    assert not r.is_reachable(n2)
+    r = r.reachable(n1, n2)
+    assert r.is_reachable(n2)
+
+
+# -- SBR strategies (reference: sbr/DowningStrategySpec) ----------------------
+
+def _members(k):
+    return [Member(UniqueAddress(f"akka://s@h:{i}", i), MemberStatus.UP,
+                   up_number=i) for i in range(1, k + 1)]
+
+
+def test_keep_majority_majority_side_survives():
+    ms = _members(5)
+    unreachable = {ms[3].unique_address, ms[4].unique_address}
+    d = KeepMajority().decide(ms, unreachable, ms[0].unique_address)
+    assert set(d.down_nodes) == unreachable
+
+
+def test_keep_majority_minority_side_downs_itself():
+    ms = _members(5)
+    unreachable = {m.unique_address for m in ms[:3]}  # we see the majority as gone
+    d = KeepMajority().decide(ms, unreachable, ms[3].unique_address)
+    assert set(d.down_nodes) == {ms[3].unique_address, ms[4].unique_address}
+
+
+def test_static_quorum():
+    ms = _members(5)
+    unreachable = {ms[4].unique_address}
+    d = StaticQuorum(3).decide(ms, unreachable, ms[0].unique_address)
+    assert set(d.down_nodes) == unreachable
+    unreachable = {m.unique_address for m in ms[:3]}
+    d = StaticQuorum(3).decide(ms, unreachable, ms[3].unique_address)
+    assert set(d.down_nodes) == {ms[3].unique_address, ms[4].unique_address}
+
+
+# -- live multi-node membership ----------------------------------------------
+
+FAST = {"akka": {"actor": {"provider": "cluster"},
+                 "stdout-loglevel": "OFF", "log-dead-letters": 0,
+                 "remote": {"transport": "inproc",
+                            "canonical": {"hostname": "local", "port": 0}},
+                 "cluster": {"gossip-interval": "0.05s",
+                             "leader-actions-interval": "0.05s",
+                             "unreachable-nodes-reaper-interval": "0.1s",
+                             "failure-detector": {
+                                 "heartbeat-interval": "0.1s",
+                                 # generous pause: a loaded CI box must not
+                                 # false-positive between LIVE nodes
+                                 "acceptable-heartbeat-pause": "2s"},
+                             "split-brain-resolver": {
+                                 "active-strategy": "keep-majority",
+                                 "stable-after": "1s"}}}}
+
+
+def _mk(name):
+    return ActorSystem.create(name, FAST)
+
+
+@pytest.fixture()
+def three_nodes():
+    InProcTransport.fault_injector.reset()
+    systems = [_mk(f"cl{i}") for i in range(3)]
+    clusters = [Cluster.get(s) for s in systems]
+    yield systems, clusters
+    for s in systems:
+        s.terminate()
+    for s in systems:
+        s.await_termination(10.0)
+    InProcTransport.fault_injector.reset()
+
+
+def _up_count(cluster):
+    return sum(1 for m in cluster.state.members
+               if m.status is MemberStatus.UP)
+
+
+def test_three_node_cluster_forms(three_nodes):
+    systems, clusters = three_nodes
+    first = str(systems[0].provider.local_address)
+    clusters[0].join(first)
+    clusters[1].join(first)
+    clusters[2].join(first)
+    await_condition(lambda: all(_up_count(c) == 3 for c in clusters),
+                    max_time=10.0, message=f"states: {[c.state for c in clusters]}")
+    # exactly one leader, agreed by all
+    leaders = {c.state.leader for c in clusters}
+    assert len(leaders) == 1
+
+
+def test_member_up_callback_and_events(three_nodes):
+    systems, clusters = three_nodes
+    first = str(systems[0].provider.local_address)
+    ups = []
+    clusters[1].register_on_member_up(lambda: ups.append("up"))
+    seen_events = []
+    clusters[1].subscribe(seen_events.append, MemberUp, initial_state=False)
+    clusters[0].join(first)
+    clusters[1].join(first)
+    await_condition(lambda: ups == ["up"], max_time=10.0)
+    await_condition(lambda: len(seen_events) >= 2, max_time=10.0)
+
+
+def test_graceful_leave(three_nodes):
+    systems, clusters = three_nodes
+    first = str(systems[0].provider.local_address)
+    for c in clusters:
+        c.join(first)
+    await_condition(lambda: all(_up_count(c) == 3 for c in clusters), max_time=10.0)
+    clusters[2].leave()
+    await_condition(lambda: _up_count(clusters[0]) == 2
+                    and len(clusters[0].state.members) == 2, max_time=10.0)
+    assert clusters[2].await_removed(10.0)
+
+
+def test_crash_detected_and_downed_by_sbr(three_nodes):
+    systems, clusters = three_nodes
+    first = str(systems[0].provider.local_address)
+    for c in clusters:
+        c.join(first)
+    await_condition(lambda: all(_up_count(c) == 3 for c in clusters), max_time=10.0)
+    crashed = str(systems[2].provider.local_address)
+    # hard-kill node 2: transport gone, no goodbye
+    systems[2].provider.shutdown_transport()
+    systems[2].terminate()
+    assert systems[2].await_termination(10.0)
+    # survivors: detect unreachable, SBR downs it after stable-after, leader removes
+    await_condition(lambda: all(len(c.state.members) == 2 for c in clusters[:2]),
+                    max_time=25.0,
+                    message=f"states: {[c.state for c in clusters[:2]]}")
+    assert all(crashed not in {m.address_str for m in c.state.members}
+               for c in clusters[:2])
