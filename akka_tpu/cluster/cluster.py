@@ -73,9 +73,13 @@ class Cluster:
         self.daemon = system.system_actor_of(
             Props.create(ClusterCoreDaemon, self), "cluster")
 
+        # downing is OPT-IN (the reference defaults to no downing provider):
+        # enable SBR only when explicitly selected, either via
+        # downing-provider-class = "sbr" or a configured active-strategy
         sbr_cfg = cfg.get_config("split-brain-resolver")
-        strategy_name = cfg.get_string("downing-provider-class", "")
-        if strategy_name != "off":
+        provider = cfg.get_string("downing-provider-class", "")
+        active = sbr_cfg.get_string("active-strategy", "")
+        if provider == "sbr" or active not in ("", "off"):
             self.sbr = system.system_actor_of(
                 Props.create(SplitBrainResolver, self,
                              strategy_from_config(sbr_cfg),
@@ -121,10 +125,12 @@ class Cluster:
         seeds = [_addr_str(s) for s in seeds]
         if not seeds:
             return
+        from .daemon import JoinSeedNodes
         if seeds[0] == self.self_unique_address.address_str:
             self.join(seeds[0])  # we are the first seed: self-join
         else:
-            self.join(seeds[0])
+            # rotate through seeds until one welcomes us
+            self.daemon.tell(JoinSeedNodes(tuple(seeds)))
 
     def leave(self, address: "str | Address | None" = None) -> None:
         target = _addr_str(address) if address is not None else \

@@ -73,6 +73,17 @@ class JoinTo:
     address_str: str
 
 
+@dataclass(frozen=True)
+class JoinSeedNodes:
+    """Local command: join the first reachable seed, retrying and rotating
+    through the list (reference: cluster/SeedNodeProcess.scala)."""
+    seeds: tuple
+
+
+class _JoinRetryTick:
+    pass
+
+
 class _GossipTick:
     pass
 
@@ -125,6 +136,7 @@ class ClusterCoreDaemon(Actor):
     def post_stop(self) -> None:
         for t in self._tasks:
             t.cancel()
+        self._stop_join_retry()
 
     # -- receive --------------------------------------------------------------
     def receive(self, message: Any):
@@ -147,7 +159,11 @@ class ClusterCoreDaemon(Actor):
         elif isinstance(message, ClusterHeartbeatRsp):
             self.fd.heartbeat(message.from_node.address_str)
         elif isinstance(message, JoinTo):
-            self._join_to(message.address_str)
+            self._start_join((message.address_str,))
+        elif isinstance(message, JoinSeedNodes):
+            self._start_join(tuple(message.seeds))
+        elif isinstance(message, _JoinRetryTick):
+            self._join_retry()
         elif isinstance(message, LeaveCmd):
             self._leaving(message.address_str)
         elif isinstance(message, DownCmd):
@@ -158,7 +174,38 @@ class ClusterCoreDaemon(Actor):
             return NotImplemented
         return None
 
-    # -- join (reference: ClusterDaemon.joining :735) --------------------------
+    # -- join (reference: ClusterDaemon.joining :735; retry semantics per
+    # SeedNodeProcess — a single Join may be dropped or arrive before the
+    # target has self-joined, so resend until welcomed) -----------------------
+    def _start_join(self, seeds: tuple) -> None:
+        self._join_seeds = seeds
+        self._join_idx = 0
+        if getattr(self, "_join_retry_task", None) is None:
+            interval = self.cluster.settings.get(
+                "retry_unsuccessful_join_after", 0.25)
+            self._join_retry_task = \
+                self.context.system.scheduler.schedule_tell_with_fixed_delay(
+                    interval, interval, self.self_ref, _JoinRetryTick())
+        self._join_to(seeds[0])
+
+    def _join_retry(self) -> None:
+        if self.gossip.has_member(self.self_node):
+            self._stop_join_retry()
+            return
+        seeds = getattr(self, "_join_seeds", ())
+        if not seeds:
+            self._stop_join_retry()
+            return
+        self._join_idx = (self._join_idx + 1) % len(seeds)
+        self._join_to(seeds[self._join_idx])
+
+    def _stop_join_retry(self) -> None:
+        task = getattr(self, "_join_retry_task", None)
+        if task is not None:
+            task.cancel()
+        self._join_retry_task = None
+        self._join_seeds = ()
+
     def _join_to(self, address_str: str) -> None:
         if address_str == self.self_node.address_str:
             # join self: become the first member of a new cluster
@@ -168,6 +215,7 @@ class ClusterCoreDaemon(Actor):
                                .bump(self.self_node)
                                .seen_by(self.self_node))
                 self._publish_changes()
+            self._stop_join_retry()
         else:
             self._send_to_addr(address_str, Join(self.self_node, self.roles))
 
@@ -317,8 +365,9 @@ class ClusterCoreDaemon(Actor):
                                   MemberStatus.UP, MemberStatus.LEAVING)]
         if not alive:
             return []
+        from ..utils.hashing import stable_hash
         ring = sorted(alive + [self.self_node],
-                      key=lambda n: hash((n.address_str, n.uid)))
+                      key=lambda n: stable_hash((n.address_str, n.uid)))
         i = ring.index(self.self_node)
         k = self.cluster.settings["monitored_by_nr_of_members"]
         out = []
@@ -383,9 +432,7 @@ class ClusterCoreDaemon(Actor):
                 self.gossip = (self.gossip.with_member(m.copy_with(MemberStatus.DOWN))
                                .bump(self.self_node)
                                .only_seen_by(self.self_node))
-                self.context.system.event_stream.publish(
-                    MemberDowned(self.gossip.member(m.unique_address)))
-                self._publish_changes()
+                self._publish_changes()  # publishes the MemberDowned event
                 if m.unique_address == self.self_node:
                     self._self_removed()
                 return

@@ -1,0 +1,35 @@
+"""Cluster services: singleton, distributed pub-sub, lease, discovery, metrics.
+
+Reference parity: akka-cluster-tools (singleton/ClusterSingletonManager.scala,
+pubsub/DistributedPubSubMediator.scala), akka-coordination
+(lease/scaladsl/LeaseProvider.scala), akka-discovery
+(discovery/ServiceDiscovery.scala), akka-cluster-metrics (EWMA.scala,
+ClusterMetricsRouting.scala). SURVEY.md §2.6.
+"""
+
+from .singleton import (ClusterSingletonManager, ClusterSingletonProxy,
+                        ClusterSingletonSettings)
+from .pubsub import (DistributedPubSub, DistributedPubSubMediator, Publish,
+                     Put, Remove, Send, SendToAll, Subscribe, SubscribeAck,
+                     Unsubscribe, UnsubscribeAck, GetTopics, CurrentTopics)
+from .lease import Lease, LeaseProvider, LeaseSettings, InProcLease, TimeoutSettings
+from .discovery import (AggregateServiceDiscovery, ConfigServiceDiscovery,
+                        Discovery, Lookup, Resolved, ResolvedTarget,
+                        ServiceDiscovery)
+from .metrics import (EWMA, AdaptiveLoadBalancingRoutingLogic,
+                      ClusterMetricsExtension, NodeMetrics,
+                      CapacityMetricsSelector, CpuMetricsSelector,
+                      MemoryMetricsSelector, MixMetricsSelector)
+
+__all__ = [
+    "ClusterSingletonManager", "ClusterSingletonProxy", "ClusterSingletonSettings",
+    "DistributedPubSub", "DistributedPubSubMediator", "Publish", "Put", "Remove",
+    "Send", "SendToAll", "Subscribe", "SubscribeAck", "Unsubscribe",
+    "UnsubscribeAck", "GetTopics", "CurrentTopics",
+    "Lease", "LeaseProvider", "LeaseSettings", "InProcLease", "TimeoutSettings",
+    "AggregateServiceDiscovery", "ConfigServiceDiscovery", "Discovery", "Lookup",
+    "Resolved", "ResolvedTarget", "ServiceDiscovery",
+    "EWMA", "AdaptiveLoadBalancingRoutingLogic", "ClusterMetricsExtension",
+    "NodeMetrics", "CapacityMetricsSelector", "CpuMetricsSelector",
+    "MemoryMetricsSelector", "MixMetricsSelector",
+]

@@ -1,0 +1,188 @@
+"""Coordination lease: pluggable distributed lock API.
+
+Reference parity: akka-coordination/src/main/scala/akka/coordination/lease/
+scaladsl/LeaseProvider.scala (:35 — config-driven impl lookup) and
+Lease.scala (acquire/release/checkLease + granted-callback on lost lease),
+LeaseSettings.scala (lease-name, owner-name, heartbeat-timeout/interval).
+
+`InProcLease` is the reference implementation for single-process multi-"node"
+tests (the analogue of a Kubernetes-lease backend): a process-global table
+keyed by lease name, with TTL expiry so a crashed owner's lease can be taken
+over after heartbeat-timeout.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+from ..actor.system import ActorSystem, ExtensionId
+
+
+@dataclass(frozen=True)
+class TimeoutSettings:
+    """(reference: lease/TimeoutSettings.scala)"""
+    heartbeat_interval: float = 0.5
+    heartbeat_timeout: float = 5.0
+    operation_timeout: float = 2.0
+
+
+@dataclass(frozen=True)
+class LeaseSettings:
+    """(reference: lease/LeaseSettings.scala)"""
+    lease_name: str
+    owner_name: str
+    timeout: TimeoutSettings = TimeoutSettings()
+
+
+class Lease:
+    """Base lease API (reference: lease/scaladsl/Lease.scala). Implementations
+    must be safe to call from any thread."""
+
+    def __init__(self, settings: LeaseSettings):
+        self.settings = settings
+
+    def acquire(self, lease_lost_callback:
+                Optional[Callable[[Optional[Exception]], None]] = None) -> bool:
+        raise NotImplementedError
+
+    def release(self) -> bool:
+        raise NotImplementedError
+
+    def check_lease(self) -> bool:
+        """True only if this owner holds the lease (and it has not expired)."""
+        raise NotImplementedError
+
+
+class _LeaseRecord:
+    __slots__ = ("owner", "deadline", "lost_cb")
+
+    def __init__(self, owner: str, deadline: float, lost_cb):
+        self.owner = owner
+        self.deadline = deadline
+        self.lost_cb = lost_cb
+
+
+class InProcLease(Lease):
+    """Process-global lease table with TTL; take-over allowed after the
+    current owner's TTL expires (expiry triggers its lost-callback)."""
+
+    _table: Dict[str, _LeaseRecord] = {}
+    _lock = threading.Lock()
+
+    def __init__(self, settings: LeaseSettings):
+        super().__init__(settings)
+        self._heartbeat_task: Optional[threading.Timer] = None
+
+    @classmethod
+    def reset_all(cls) -> None:
+        with cls._lock:
+            cls._table.clear()
+
+    def _ttl(self) -> float:
+        return self.settings.timeout.heartbeat_timeout
+
+    def acquire(self, lease_lost_callback=None) -> bool:
+        name, owner = self.settings.lease_name, self.settings.owner_name
+        now = time.monotonic()
+        with InProcLease._lock:
+            rec = InProcLease._table.get(name)
+            if rec is not None and rec.owner != owner and rec.deadline > now:
+                return False
+            if rec is not None and rec.owner != owner and rec.deadline <= now:
+                if rec.lost_cb:
+                    try:
+                        rec.lost_cb(None)
+                    except Exception:
+                        pass
+            InProcLease._table[name] = _LeaseRecord(
+                owner, now + self._ttl(), lease_lost_callback)
+        self._start_heartbeat()
+        return True
+
+    def _start_heartbeat(self) -> None:
+        self._stop_heartbeat()
+
+        def beat():
+            name, owner = self.settings.lease_name, self.settings.owner_name
+            with InProcLease._lock:
+                rec = InProcLease._table.get(name)
+                if rec is None or rec.owner != owner:
+                    return  # lost; stop beating
+                rec.deadline = time.monotonic() + self._ttl()
+            self._start_heartbeat()
+
+        t = threading.Timer(self.settings.timeout.heartbeat_interval, beat)
+        t.daemon = True
+        t.start()
+        self._heartbeat_task = t
+
+    def _stop_heartbeat(self) -> None:
+        if self._heartbeat_task is not None:
+            self._heartbeat_task.cancel()
+            self._heartbeat_task = None
+
+    def release(self) -> bool:
+        name, owner = self.settings.lease_name, self.settings.owner_name
+        self._stop_heartbeat()
+        with InProcLease._lock:
+            rec = InProcLease._table.get(name)
+            if rec is not None and rec.owner == owner:
+                del InProcLease._table[name]
+            return True
+
+    def check_lease(self) -> bool:
+        name, owner = self.settings.lease_name, self.settings.owner_name
+        with InProcLease._lock:
+            rec = InProcLease._table.get(name)
+            return (rec is not None and rec.owner == owner
+                    and rec.deadline > time.monotonic())
+
+
+_LEASE_IMPLS: Dict[str, Callable[[LeaseSettings], Lease]] = {
+    "in-proc": InProcLease,
+}
+
+
+def register_lease_impl(name: str, factory: Callable[[LeaseSettings], Lease]) -> None:
+    """Config-style extension seam (reference: LeaseProvider loads the
+    `lease-class` FQCN from config; here a registry name)."""
+    _LEASE_IMPLS[name] = factory
+
+
+class LeaseProvider(ExtensionId):
+    """(reference: lease/scaladsl/LeaseProvider.scala:35) — per-system cache
+    of (impl, lease-name, owner) -> Lease instance."""
+
+    def create_extension(self, system: ActorSystem) -> "_LeaseProviderExt":
+        return _LeaseProviderExt(system)
+
+    @staticmethod
+    def get(system: ActorSystem) -> "_LeaseProviderExt":
+        return system.register_extension(LeaseProvider())
+
+
+class _LeaseProviderExt:
+    def __init__(self, system: ActorSystem):
+        self.system = system
+        self._leases: Dict[tuple, Lease] = {}
+        self._lock = threading.Lock()
+
+    def get_lease(self, lease_name: str, config_path: str,
+                  owner_name: str) -> Lease:
+        key = (lease_name, config_path, owner_name)
+        with self._lock:
+            if key not in self._leases:
+                cfg = self.system.settings.config
+                impl = cfg.get_string(f"{config_path}.lease-implementation",
+                                      "in-proc")
+                timeout = TimeoutSettings(
+                    heartbeat_interval=cfg.get_duration(
+                        f"{config_path}.heartbeat-interval", 0.5),
+                    heartbeat_timeout=cfg.get_duration(
+                        f"{config_path}.heartbeat-timeout", 5.0))
+                settings = LeaseSettings(lease_name, owner_name, timeout)
+                self._leases[key] = _LEASE_IMPLS[impl](settings)
+            return self._leases[key]

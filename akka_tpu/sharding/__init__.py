@@ -1,0 +1,35 @@
+"""Cluster sharding: entities → shards → regions (SURVEY.md §2.5).
+
+Control plane (this package): host actors mirroring the reference's
+ShardRegion / ShardCoordinator / Shard protocol. Data plane: the sharded
+batched runtime (akka_tpu/batched/sharded.py) maps shards onto mesh axes with
+all_to_all exchange — the TPU-native analogue noted in SURVEY.md §2.5.
+"""
+
+from .messages import (BeginHandOff, ClusterShardingStats,
+                       CurrentShardRegionState, GetClusterShardingStats,
+                       GetShardHome, GetShardRegionState, HandOff, HostShard,
+                       Passivate, Register, RegisterAck, ShardHome,
+                       ShardingEnvelope, ShardState, ShardStopped, StartEntity,
+                       StartEntityAck)
+from .coordinator import (LeastShardAllocationStrategy,
+                          ShardAllocationStrategy, ShardCoordinator)
+from .region import (ClusterShardingSettings, InProcRememberEntitiesStore,
+                     RememberEntitiesStore, Shard, ShardRegion,
+                     default_extract_entity_id, make_default_extract_shard_id)
+from .sharding import ClusterSharding
+from .typed import (ClusterShardingTyped, Entity, EntityContext, EntityRef,
+                    EntityTypeKey)
+
+__all__ = [
+    "ShardingEnvelope", "StartEntity", "StartEntityAck", "Passivate",
+    "ClusterSharding", "ClusterShardingSettings", "ShardRegion", "Shard",
+    "ShardCoordinator", "ShardAllocationStrategy",
+    "LeastShardAllocationStrategy", "RememberEntitiesStore",
+    "InProcRememberEntitiesStore", "default_extract_entity_id",
+    "make_default_extract_shard_id", "GetShardRegionState",
+    "CurrentShardRegionState", "GetClusterShardingStats",
+    "ClusterShardingStats", "ShardState",
+    "ClusterShardingTyped", "Entity", "EntityContext", "EntityRef",
+    "EntityTypeKey",
+]

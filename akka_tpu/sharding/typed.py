@@ -1,0 +1,96 @@
+"""Typed sharding façade: EntityTypeKey / Entity / init / EntityRef.
+
+Reference parity: akka-cluster-sharding-typed/src/main/scala/akka/cluster/
+sharding/typed/scaladsl/ClusterSharding.scala (:178 init, :234 entityRefFor,
+:362 ShardingEnvelope) — entities are typed Behaviors; `init(Entity(key,
+ctx -> behavior))` returns an ActorRef[ShardingEnvelope].
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ..actor.ref import ActorRef
+from ..pattern.ask import ask
+from ..typed.adapter import props_from_behavior
+from .messages import ShardingEnvelope
+from .region import ClusterShardingSettings
+from .sharding import ClusterSharding as _ClassicSharding
+
+
+@dataclass(frozen=True)
+class EntityTypeKey:
+    """(reference: scaladsl/EntityTypeKey.scala)"""
+    name: str
+
+
+@dataclass(frozen=True)
+class EntityContext:
+    entity_type_key: EntityTypeKey
+    entity_id: str
+    shard: Optional[ActorRef] = None
+
+
+@dataclass(frozen=True)
+class Entity:
+    """(reference: scaladsl/Entity.scala) — behavior factory per entity."""
+    type_key: EntityTypeKey
+    create_behavior: Callable[[EntityContext], Any]
+    settings: Optional[ClusterShardingSettings] = None
+    stop_message: Any = None
+    extract_entity_id: Any = None
+    extract_shard_id: Any = None
+
+
+class EntityRef:
+    """(reference: EntityRef — tell/ask addressed by entity id)"""
+
+    def __init__(self, region: ActorRef, type_key: EntityTypeKey,
+                 entity_id: str, system):
+        self.region = region
+        self.type_key = type_key
+        self.entity_id = entity_id
+        self._system = system
+
+    def tell(self, message: Any, sender: Optional[ActorRef] = None) -> None:
+        self.region.tell(ShardingEnvelope(self.entity_id, message), sender)
+
+    def ask(self, message: Any, timeout: float = 5.0):
+        return ask(self.region, ShardingEnvelope(self.entity_id, message),
+                   timeout=timeout, system=self._system)
+
+    def __repr__(self) -> str:
+        return f"EntityRef({self.type_key.name}/{self.entity_id})"
+
+
+class ClusterShardingTyped:
+    """`ClusterShardingTyped.get(system).init(Entity(...))`"""
+
+    def __init__(self, system):
+        self.system = system
+        self._classic = _ClassicSharding.get(system)
+
+    @staticmethod
+    def get(system) -> "ClusterShardingTyped":
+        return ClusterShardingTyped(system)
+
+    def init(self, entity: Entity) -> ActorRef:
+        key = entity.type_key
+
+        def props_factory(entity_id: str):
+            behavior = entity.create_behavior(EntityContext(key, entity_id))
+            return props_from_behavior(behavior)
+
+        return self._classic.start(
+            key.name, props_factory, entity.settings,
+            extract_entity_id=entity.extract_entity_id,
+            extract_shard_id=entity.extract_shard_id)
+
+    def entity_ref_for(self, type_key: EntityTypeKey,
+                       entity_id: str) -> EntityRef:
+        region = self._classic.shard_region(type_key.name)
+        return EntityRef(region, type_key, entity_id, self.system)
+
+    def shard_region(self, type_key: EntityTypeKey) -> ActorRef:
+        return self._classic.shard_region(type_key.name)

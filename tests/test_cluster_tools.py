@@ -1,0 +1,245 @@
+"""Cluster tools tests — modeled on the reference multi-jvm specs
+(akka-cluster-tools/src/multi-jvm: ClusterSingletonManagerSpec,
+DistributedPubSubMediatorSpec) and unit specs (EWMASpec, MetricsSelectorSpec,
+lease TestKit), run over the in-proc transport."""
+
+import time
+
+import pytest
+
+from akka_tpu import ActorSystem, Props
+from akka_tpu.actor.actor import Actor
+from akka_tpu.cluster import Cluster
+from akka_tpu.cluster_tools import (EWMA, ClusterSingletonManager,
+                                    ClusterSingletonProxy,
+                                    ClusterSingletonSettings,
+                                    ConfigServiceDiscovery, CpuMetricsSelector,
+                                    DistributedPubSub, InProcLease,
+                                    LeaseProvider, LeaseSettings, Lookup,
+                                    MemoryMetricsSelector, NodeMetrics,
+                                    Publish, Put, Send, SendToAll, Subscribe,
+                                    SubscribeAck, TimeoutSettings)
+from akka_tpu.cluster_tools.metrics import (CPU_COMBINED, HEAP_MEMORY_MAX,
+                                            HEAP_MEMORY_USED, Metric,
+                                            MetricsCollector)
+from akka_tpu.remote.transport import InProcTransport
+from akka_tpu.testkit import TestProbe, await_condition
+
+FAST = {"akka": {"actor": {"provider": "cluster"},
+                 "stdout-loglevel": "OFF", "log-dead-letters": 0,
+                 "remote": {"transport": "inproc",
+                            "canonical": {"hostname": "local", "port": 0}},
+                 "cluster": {"gossip-interval": "0.05s",
+                             "leader-actions-interval": "0.05s",
+                             "unreachable-nodes-reaper-interval": "0.1s",
+                             "failure-detector": {
+                                 "heartbeat-interval": "0.1s",
+                                 "acceptable-heartbeat-pause": "2s"},
+                             "pub-sub": {"gossip-interval": "0.05s"}}}}
+
+
+@pytest.fixture()
+def three_nodes():
+    InProcTransport.fault_injector.reset()
+    systems = [ActorSystem.create(f"ct{i}", FAST) for i in range(3)]
+    clusters = [Cluster.get(s) for s in systems]
+    first = str(systems[0].provider.local_address)
+    for c in clusters:
+        c.join(first)
+    await_condition(
+        lambda: all(len([m for m in c.state.members
+                         if m.status.value == "Up"]) == 3 for c in clusters),
+        max_time=10.0)
+    yield systems, clusters
+    for s in systems:
+        s.terminate()
+    for s in systems:
+        s.await_termination(10.0)
+    InProcTransport.fault_injector.reset()
+
+
+class Echo(Actor):
+    def receive(self, message):
+        if message == "ping":
+            self.sender.tell(("pong", str(self.context.system.name)), self.self_ref)
+        else:
+            self.sender.tell(message, self.self_ref)
+
+
+# -- singleton ---------------------------------------------------------------
+
+def test_singleton_runs_on_oldest_and_proxy_routes(three_nodes):
+    systems, clusters = three_nodes
+    settings = ClusterSingletonSettings(singleton_name="echo")
+    for s in systems:
+        s.actor_of(Props.create(ClusterSingletonManager,
+                                Props.create(Echo), settings), "echo-manager")
+    probe = TestProbe(systems[2])
+    proxy = systems[2].actor_of(
+        Props.create(ClusterSingletonProxy, "/user/echo-manager", settings),
+        "echo-proxy")
+    proxy.tell("ping", probe.ref)
+    kind, host = probe.receive_one(5.0)
+    assert kind == "pong"
+    # singleton must be hosted on the OLDEST node (the first to join → ct0)
+    assert host == "ct0"
+
+
+def test_singleton_hand_over_on_leave(three_nodes):
+    systems, clusters = three_nodes
+    settings = ClusterSingletonSettings(singleton_name="echo",
+                                        hand_over_retry_interval=0.1)
+    for s in systems:
+        s.actor_of(Props.create(ClusterSingletonManager,
+                                Props.create(Echo), settings), "echo-manager")
+    probe = TestProbe(systems[2])
+    proxy = systems[2].actor_of(
+        Props.create(ClusterSingletonProxy, "/user/echo-manager",
+                     ClusterSingletonSettings(
+                         singleton_name="echo",
+                         singleton_identification_interval=0.1)),
+        "echo-proxy")
+    proxy.tell("ping", probe.ref)
+    assert probe.receive_one(5.0)[1] == "ct0"
+    # oldest leaves; singleton must move to the next-oldest (ct1)
+    clusters[0].leave()
+
+    def moved():
+        proxy.tell("ping", probe.ref)
+        try:
+            return probe.receive_one(1.0)[1] == "ct1"
+        except AssertionError:
+            return False
+    await_condition(moved, max_time=10.0)
+
+
+# -- pub-sub -----------------------------------------------------------------
+
+def test_pubsub_publish_reaches_remote_subscribers(three_nodes):
+    systems, _ = three_nodes
+    meds = [DistributedPubSub.get(s).mediator for s in systems]
+    probes = [TestProbe(s) for s in systems]
+    for med, probe in zip(meds[1:], probes[1:]):
+        med.tell(Subscribe("news", probe.ref))
+    for probe in probes[1:]:
+        assert isinstance(probe.receive_one(5.0), SubscribeAck)
+    # wait until node0's mediator has gossip-learned the topic FROM BOTH
+    # subscriber nodes (publishing earlier would miss the laggard's bucket)
+    await_condition(
+        lambda: len(_topic_nodes(meds[0], systems[0], "news")) == 2,
+        max_time=10.0)
+    meds[0].tell(Publish("news", "flash"))
+    for probe in probes[1:]:
+        assert probe.receive_one(5.0) == "flash"
+
+
+def _topic_nodes(mediator, system, topic):
+    from akka_tpu.cluster_tools.pubsub import GetRegistryState
+    probe = TestProbe(system)
+    mediator.tell(GetRegistryState(), probe.ref)
+    state = probe.receive_one(2.0)
+    return state.get(f"topic:{topic}", [])
+
+
+def test_pubsub_send_routes_to_registered_path(three_nodes):
+    systems, _ = three_nodes
+    meds = [DistributedPubSub.get(s).mediator for s in systems]
+    probe1 = TestProbe(systems[1])
+    echo1 = systems[1].actor_of(Props.create(Echo), "svc")
+    meds[1].tell(Put(echo1))
+
+    def registered():
+        meds0 = DistributedPubSub.get(systems[0]).mediator
+        p = TestProbe(systems[0])
+        meds0.tell(Send("/user/svc", "ping", local_affinity=True), p.ref)
+        try:
+            return p.receive_one(1.0)[0] == "pong"
+        except AssertionError:
+            return False
+    await_condition(registered, max_time=10.0)
+    # SendToAll reaches every registered node's instance
+    echo2 = systems[2].actor_of(Props.create(Echo), "svc")
+    meds[2].tell(Put(echo2))
+    probe0 = TestProbe(systems[0])
+
+    def both():
+        meds[0].tell(SendToAll("/user/svc", "ping"), probe0.ref)
+        hosts = set()
+        try:
+            for _ in range(2):
+                hosts.add(probe0.receive_one(1.0)[1])
+        except AssertionError:
+            pass
+        return hosts == {"ct1", "ct2"}
+    await_condition(both, max_time=10.0)
+
+
+# -- lease -------------------------------------------------------------------
+
+def test_lease_mutual_exclusion_and_expiry():
+    InProcLease.reset_all()
+    t = TimeoutSettings(heartbeat_interval=10.0, heartbeat_timeout=0.3)
+    a = InProcLease(LeaseSettings("shard-0", "ownerA", t))
+    b = InProcLease(LeaseSettings("shard-0", "ownerB", t))
+    lost = []
+    assert a.acquire(lost.append)
+    assert a.check_lease()
+    assert not b.acquire()
+    # a's heartbeat interval is long -> TTL expires -> b takes over
+    time.sleep(0.4)
+    assert b.acquire()
+    assert b.check_lease()
+    assert not a.check_lease()
+    assert lost == [None]
+    assert b.release()
+    InProcLease.reset_all()
+
+
+def test_lease_provider_extension():
+    with ActorSystem.create("lp", {"akka": {"stdout-loglevel": "OFF"}}) as sys_:
+        provider = LeaseProvider.get(sys_)
+        lease = provider.get_lease("my-lease", "akka.coordination.lease", "me")
+        assert isinstance(lease, InProcLease)
+        assert provider.get_lease("my-lease", "akka.coordination.lease",
+                                  "me") is lease
+    InProcLease.reset_all()
+
+
+# -- discovery ---------------------------------------------------------------
+
+def test_config_service_discovery():
+    cfg = {"akka": {"stdout-loglevel": "OFF",
+                    "discovery": {"method": "config", "config": {"services": {
+                        "web": {"endpoints": ["10.0.0.1:8080", "10.0.0.2:8080"]}}}}}}
+    with ActorSystem.create("disc", cfg) as sys_:
+        from akka_tpu.cluster_tools import Discovery
+        d = Discovery.get(sys_).discovery
+        res = d.lookup(Lookup("web"))
+        assert [t.port for t in res.addresses] == [8080, 8080]
+        assert d.lookup(Lookup("nope")).addresses == ()
+
+
+# -- metrics -----------------------------------------------------------------
+
+def test_ewma_decays_toward_new_value():
+    alpha = EWMA.alpha_for(half_life=1.0, collect_interval=1.0)
+    assert abs(alpha - 0.5) < 1e-9  # one half-life per sample -> alpha = 0.5
+    e = EWMA(0.0, alpha)
+    e = e + 10.0
+    assert abs(e.value - 5.0) < 1e-9
+
+
+def test_metrics_collector_samples_host():
+    s = MetricsCollector().sample()
+    assert CPU_COMBINED in s or HEAP_MEMORY_MAX in s
+
+
+def test_capacity_selectors():
+    nm = NodeMetrics("a", 0.0, {
+        CPU_COMBINED: Metric(CPU_COMBINED, 0.25, None),
+        HEAP_MEMORY_USED: Metric(HEAP_MEMORY_USED, 250.0, None),
+        HEAP_MEMORY_MAX: Metric(HEAP_MEMORY_MAX, 1000.0, None)})
+    assert CpuMetricsSelector().capacity({"a": nm})["a"] == 0.75
+    assert MemoryMetricsSelector().capacity({"a": nm})["a"] == 0.75
+    w = CpuMetricsSelector().weights({"a": nm})
+    assert w["a"] >= 1

@@ -1,0 +1,223 @@
+"""Cluster sharding tests — modeled on the reference multi-jvm specs
+(akka-cluster-sharding/src/multi-jvm: ClusterShardingSpec,
+ClusterShardingRebalanceSpec, ClusterShardingRememberEntitiesSpec) and unit
+specs (LeastShardAllocationStrategySpec), over the in-proc transport."""
+
+import pytest
+
+from akka_tpu import ActorSystem, Props
+from akka_tpu.actor.actor import Actor
+from akka_tpu.cluster import Cluster
+from akka_tpu.sharding import (ClusterSharding, ClusterShardingSettings,
+                               ClusterShardingTyped, Entity, EntityTypeKey,
+                               InProcRememberEntitiesStore,
+                               LeastShardAllocationStrategy, Passivate,
+                               ShardingEnvelope, StartEntity, StartEntityAck)
+from akka_tpu.remote.transport import InProcTransport
+from akka_tpu.testkit import TestProbe, await_condition
+from akka_tpu.typed import Behaviors
+
+FAST = {"akka": {"actor": {"provider": "cluster"},
+                 "stdout-loglevel": "OFF", "log-dead-letters": 0,
+                 "remote": {"transport": "inproc",
+                            "canonical": {"hostname": "local", "port": 0}},
+                 "cluster": {"gossip-interval": "0.05s",
+                             "leader-actions-interval": "0.05s",
+                             "unreachable-nodes-reaper-interval": "0.1s",
+                             "failure-detector": {
+                                 "heartbeat-interval": "0.1s",
+                                 "acceptable-heartbeat-pause": "2s"}}}}
+
+SETTINGS = ClusterShardingSettings(number_of_shards=8, retry_interval=0.1,
+                                   rebalance_interval=0.3)
+
+
+class Counter(Actor):
+    """Per-entity counter; replies (entity_path_host, count)."""
+
+    def __init__(self):
+        super().__init__()
+        self.count = 0
+
+    def receive(self, message):
+        if message == "inc":
+            self.count += 1
+        elif message == "get":
+            self.sender.tell((str(self.context.system.name), self.count),
+                             self.self_ref)
+        elif message == "passivate":
+            self.context.parent.tell(Passivate(), self.self_ref)
+        else:
+            return NotImplemented
+
+
+# -- allocation strategy unit tests ------------------------------------------
+
+def test_least_shard_allocation():
+    s = LeastShardAllocationStrategy(rebalance_threshold=1,
+                                     max_simultaneous_rebalance=3)
+    current = {"r1": ["a", "b", "c"], "r2": ["d"], "r3": []}
+    assert s.allocate_shard("r1", "x", current) == "r3"
+    moves = s.rebalance(current, set())
+    assert "a" in moves  # from the most loaded region
+    assert not s.rebalance({"r1": ["a"], "r2": []}, set())  # within threshold
+    assert not s.rebalance(current, {"m1", "m2", "m3"})  # limit in flight
+
+
+# -- single-node hosting ------------------------------------------------------
+
+@pytest.fixture()
+def one_node():
+    InProcTransport.fault_injector.reset()
+    InProcRememberEntitiesStore.reset()
+    s = ActorSystem.create("sh0", FAST)
+    c = Cluster.get(s)
+    c.join(str(s.provider.local_address))
+    await_condition(lambda: any(m.status.value == "Up"
+                                for m in c.state.members), max_time=10.0)
+    yield s
+    s.terminate()
+    s.await_termination(10.0)
+    InProcRememberEntitiesStore.reset()
+
+
+def test_entities_receive_and_keep_state(one_node):
+    region = ClusterSharding.get(one_node).start(
+        "counters", Props.create(Counter), SETTINGS)
+    probe = TestProbe(one_node)
+    for _ in range(3):
+        region.tell(ShardingEnvelope("e1", "inc"), probe.ref)
+    region.tell(ShardingEnvelope("e2", "inc"), probe.ref)
+
+    def counted():
+        region.tell(ShardingEnvelope("e1", "get"), probe.ref)
+        try:
+            return probe.receive_one(1.0)[1] == 3
+        except AssertionError:
+            return False
+    await_condition(counted, max_time=10.0)
+    region.tell(ShardingEnvelope("e2", "get"), probe.ref)
+    assert probe.receive_one(5.0)[1] == 1
+
+
+def test_start_entity_and_passivation(one_node):
+    region = ClusterSharding.get(one_node).start(
+        "counters", Props.create(Counter), SETTINGS)
+    probe = TestProbe(one_node)
+    region.tell(StartEntity("e9"), probe.ref)
+    ack = probe.expect_msg_class(StartEntityAck, timeout=5.0)
+    assert ack.entity_id == "e9"
+    # passivate, then a new message restarts it with fresh state
+    region.tell(ShardingEnvelope("e9", "inc"), probe.ref)
+    region.tell(ShardingEnvelope("e9", "passivate"), probe.ref)
+
+    def restarted():
+        region.tell(ShardingEnvelope("e9", "get"), probe.ref)
+        try:
+            return probe.receive_one(1.0)[1] == 0  # state reset after stop
+        except AssertionError:
+            return False
+    await_condition(restarted, max_time=10.0)
+
+
+# -- multi-node: distribution, forwarding, rebalance --------------------------
+
+@pytest.fixture()
+def two_nodes():
+    InProcTransport.fault_injector.reset()
+    InProcRememberEntitiesStore.reset()
+    systems = [ActorSystem.create(f"sh{i}", FAST) for i in range(2)]
+    clusters = [Cluster.get(s) for s in systems]
+    first = str(systems[0].provider.local_address)
+    for c in clusters:
+        c.join(first)
+    await_condition(
+        lambda: all(len([m for m in c.state.members
+                         if m.status.value == "Up"]) == 2 for c in clusters),
+        max_time=10.0)
+    yield systems, clusters
+    for s in systems:
+        s.terminate()
+    for s in systems:
+        s.await_termination(10.0)
+    InProcTransport.fault_injector.reset()
+    InProcRememberEntitiesStore.reset()
+
+
+def test_cross_node_forwarding_and_rebalance(two_nodes):
+    systems, clusters = two_nodes
+    regions = [ClusterSharding.get(s).start("counters", Props.create(Counter),
+                                            SETTINGS) for s in systems]
+    probe0 = TestProbe(systems[0])
+    probe1 = TestProbe(systems[1])
+    # drive all 8 shards from node0; rebalance should spread them
+    for i in range(32):
+        regions[0].tell(ShardingEnvelope(f"e{i}", "inc"), probe0.ref)
+
+    def spread():
+        hosts = set()
+        for i in range(32):
+            regions[1].tell(ShardingEnvelope(f"e{i}", "get"), probe1.ref)
+        try:
+            for _ in range(32):
+                hosts.add(probe1.receive_one(2.0)[0])
+        except AssertionError:
+            return False
+        return hosts == {"sh0", "sh1"}
+    await_condition(spread, max_time=20.0)
+    # the entity answers from wherever it now lives; a rebalanced entity
+    # restarts fresh (state continuity needs persistence/remember-entities)
+    regions[1].tell(ShardingEnvelope("e5", "get"), probe1.ref)
+    assert probe1.receive_one(5.0)[1] in (0, 1)
+
+
+def test_remember_entities_restart_after_rebalance(two_nodes):
+    systems, _ = two_nodes
+    settings = ClusterShardingSettings(number_of_shards=2, retry_interval=0.1,
+                                       rebalance_interval=0.3,
+                                       remember_entities=True)
+    store = InProcRememberEntitiesStore()
+    regions = [ClusterSharding.get(s).start("rem", Props.create(Counter),
+                                            settings, store=store)
+               for s in systems]
+    probe = TestProbe(systems[0])
+    regions[0].tell(ShardingEnvelope("r1", "inc"), probe.ref)
+
+    def remembered():
+        return any(store.remembered("rem", str(s)) == {"r1"}
+                   for s in range(2))
+    await_condition(remembered, max_time=10.0)
+
+
+# -- typed façade -------------------------------------------------------------
+
+def typed_counter(entity_id: str):
+    def behavior(count=0):
+        def on_message(ctx, msg):
+            if isinstance(msg, tuple) and msg[0] == "get":
+                msg[1].tell((entity_id, count))
+                return Behaviors.same()
+            if msg == "inc":
+                return behavior(count + 1)
+            return Behaviors.same()
+        return Behaviors.receive(on_message)
+    return behavior()
+
+
+def test_typed_entity_ref(one_node):
+    key = EntityTypeKey("typed-counters")
+    sharding = ClusterShardingTyped.get(one_node)
+    sharding.init(Entity(key, lambda ctx: typed_counter(ctx.entity_id),
+                         settings=SETTINGS))
+    ref = sharding.entity_ref_for(key, "alice")
+    probe = TestProbe(one_node)
+    ref.tell("inc")
+    ref.tell("inc")
+
+    def counted():
+        ref.tell(("get", probe.ref))
+        try:
+            return probe.receive_one(1.0) == ("alice", 2)
+        except AssertionError:
+            return False
+    await_condition(counted, max_time=10.0)
