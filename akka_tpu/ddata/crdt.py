@@ -1,0 +1,728 @@
+"""The CRDT library: state-based convergent replicated data types.
+
+Reference parity (akka-distributed-data/src/main/scala/akka/cluster/ddata/):
+GCounter.scala, PNCounter.scala, GSet.scala, ORSet.scala (add-wins via
+per-element "dots" = version vectors), ORMap.scala, ORMultiMap.scala,
+PNCounterMap.scala, LWWMap.scala, LWWRegister.scala, Flag.scala.
+
+Contracts (reference: ReplicatedData.scala):
+- `merge(other)` is commutative, associative, idempotent.
+- DeltaReplicatedData additionally accumulates a `delta` between replicator
+  ticks (`delta`, `reset_delta`, `merge_delta`) so gossip can ship small
+  updates (delta-CRDT, Replicator.scala:98-99, DeltaPropagationSelector.scala).
+- RemovedNodePruning lets the leader collapse a removed node's contributions
+  into a surviving node (`needs_pruning_from`, `prune`).
+
+Mutators take a `node` (the SelfUniqueAddress string) exactly like the
+reference's implicit `SelfUniqueAddress`.
+
+Tensor note: GCounter/PNCounter merge is elementwise max over per-node rows —
+the psum-shaped bulk form lives in akka_tpu/ddata/tensor.py; these host types
+are the unit of the Replicator control plane.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, FrozenSet, Generic, Optional, Tuple, TypeVar
+
+from .version_vector import Ordering, VersionVector
+
+A = TypeVar("A")
+
+
+class ReplicatedData:
+    """Base marker (reference: ReplicatedData.scala)."""
+
+    def merge(self, other: "ReplicatedData") -> "ReplicatedData":
+        raise NotImplementedError
+
+
+class DeltaReplicatedData(ReplicatedData):
+    @property
+    def delta(self) -> Optional[ReplicatedData]:
+        return None
+
+    def reset_delta(self) -> "DeltaReplicatedData":
+        return self
+
+    def merge_delta(self, delta: ReplicatedData) -> "DeltaReplicatedData":
+        return self.merge(delta)  # full-state deltas by default
+
+
+class RemovedNodePruning:
+    def modified_by_nodes(self) -> FrozenSet[str]:
+        raise NotImplementedError
+
+    def needs_pruning_from(self, removed: str) -> bool:
+        return removed in self.modified_by_nodes()
+
+    def prune(self, removed: str, collapse_into: str) -> "ReplicatedData":
+        raise NotImplementedError
+
+    def prune_cleanup(self, removed: str) -> "ReplicatedData":
+        """Drop any residual entries for `removed` (post-prune gossip races)."""
+        return self  # type: ignore[return-value]
+
+
+# -- counters ---------------------------------------------------------------
+
+
+class GCounter(DeltaReplicatedData, RemovedNodePruning):
+    """Grow-only counter: node -> count, merge = pairwise max, value = sum
+    (reference: GCounter.scala)."""
+
+    __slots__ = ("state", "_delta")
+
+    def __init__(self, state: Optional[Dict[str, int]] = None,
+                 _delta: Optional["GCounter"] = None):
+        self.state = dict(state or {})
+        self._delta = _delta
+
+    @staticmethod
+    def empty() -> "GCounter":
+        return GCounter()
+
+    @property
+    def value(self) -> int:
+        return sum(self.state.values())
+
+    def increment(self, node: str, n: int = 1) -> "GCounter":
+        if n < 0:
+            raise ValueError("GCounter can't decrement")
+        if n == 0:
+            return self
+        new = dict(self.state)
+        new[node] = new.get(node, 0) + n
+        delta_state = {node: new[node]}
+        d = GCounter(delta_state) if self._delta is None else \
+            GCounter({**self._delta.state, **delta_state})
+        return GCounter(new, d)
+
+    def merge(self, other: "GCounter") -> "GCounter":
+        new = dict(self.state)
+        for k, v in other.state.items():
+            if new.get(k, 0) < v:
+                new[k] = v
+        return GCounter(new, self._delta)
+
+    @property
+    def delta(self) -> Optional["GCounter"]:
+        return self._delta
+
+    def reset_delta(self) -> "GCounter":
+        return GCounter(self.state)
+
+    def merge_delta(self, delta: "GCounter") -> "GCounter":
+        return self.merge(delta)
+
+    def modified_by_nodes(self) -> FrozenSet[str]:
+        return frozenset(self.state)
+
+    def prune(self, removed: str, collapse_into: str) -> "GCounter":
+        c = self.state.get(removed)
+        if c is None:
+            return self
+        new = dict(self.state)
+        del new[removed]
+        new[collapse_into] = new.get(collapse_into, 0) + c
+        return GCounter(new)
+
+    def prune_cleanup(self, removed: str) -> "GCounter":
+        if removed not in self.state:
+            return self
+        new = dict(self.state)
+        del new[removed]
+        return GCounter(new)
+
+    def __eq__(self, other):
+        return isinstance(other, GCounter) and self.state == other.state
+
+    def __hash__(self):
+        return hash(tuple(sorted(self.state.items())))
+
+    def __repr__(self):
+        return f"GCounter({self.value})"
+
+
+class PNCounter(DeltaReplicatedData, RemovedNodePruning):
+    """Increment+decrement counter = two GCounters (reference: PNCounter.scala)."""
+
+    __slots__ = ("increments", "decrements")
+
+    def __init__(self, increments: Optional[GCounter] = None,
+                 decrements: Optional[GCounter] = None):
+        self.increments = increments or GCounter()
+        self.decrements = decrements or GCounter()
+
+    @staticmethod
+    def empty() -> "PNCounter":
+        return PNCounter()
+
+    @property
+    def value(self) -> int:
+        return self.increments.value - self.decrements.value
+
+    def increment(self, node: str, n: int = 1) -> "PNCounter":
+        if n < 0:
+            return self.decrement(node, -n)
+        return PNCounter(self.increments.increment(node, n), self.decrements)
+
+    def decrement(self, node: str, n: int = 1) -> "PNCounter":
+        if n < 0:
+            return self.increment(node, -n)
+        return PNCounter(self.increments, self.decrements.increment(node, n))
+
+    def merge(self, other: "PNCounter") -> "PNCounter":
+        return PNCounter(self.increments.merge(other.increments),
+                         self.decrements.merge(other.decrements))
+
+    @property
+    def delta(self) -> Optional["PNCounter"]:
+        di, dd = self.increments.delta, self.decrements.delta
+        if di is None and dd is None:
+            return None
+        return PNCounter(di or GCounter(), dd or GCounter())
+
+    def reset_delta(self) -> "PNCounter":
+        return PNCounter(self.increments.reset_delta(),
+                         self.decrements.reset_delta())
+
+    def merge_delta(self, delta: "PNCounter") -> "PNCounter":
+        return self.merge(delta)
+
+    def modified_by_nodes(self) -> FrozenSet[str]:
+        return self.increments.modified_by_nodes() | self.decrements.modified_by_nodes()
+
+    def prune(self, removed: str, collapse_into: str) -> "PNCounter":
+        return PNCounter(self.increments.prune(removed, collapse_into),
+                         self.decrements.prune(removed, collapse_into))
+
+    def prune_cleanup(self, removed: str) -> "PNCounter":
+        return PNCounter(self.increments.prune_cleanup(removed),
+                         self.decrements.prune_cleanup(removed))
+
+    def __eq__(self, other):
+        return (isinstance(other, PNCounter)
+                and self.increments == other.increments
+                and self.decrements == other.decrements)
+
+    def __hash__(self):
+        return hash((self.increments, self.decrements))
+
+    def __repr__(self):
+        return f"PNCounter({self.value})"
+
+
+# -- sets -------------------------------------------------------------------
+
+
+class GSet(DeltaReplicatedData, Generic[A]):
+    """Grow-only set; merge = union (reference: GSet.scala)."""
+
+    __slots__ = ("elements", "_delta")
+
+    def __init__(self, elements: Optional[FrozenSet[A]] = None,
+                 _delta: Optional["GSet[A]"] = None):
+        self.elements: FrozenSet[A] = frozenset(elements or ())
+        self._delta = _delta
+
+    @staticmethod
+    def empty() -> "GSet":
+        return GSet()
+
+    def contains(self, e: A) -> bool:
+        return e in self.elements
+
+    def __contains__(self, e: A) -> bool:
+        return e in self.elements
+
+    def add(self, e: A) -> "GSet[A]":
+        d = GSet(frozenset({e}) | (self._delta.elements if self._delta else frozenset()))
+        return GSet(self.elements | {e}, d)
+
+    def merge(self, other: "GSet[A]") -> "GSet[A]":
+        return GSet(self.elements | other.elements, self._delta)
+
+    @property
+    def delta(self) -> Optional["GSet[A]"]:
+        return self._delta
+
+    def reset_delta(self) -> "GSet[A]":
+        return GSet(self.elements)
+
+    def merge_delta(self, delta: "GSet[A]") -> "GSet[A]":
+        return self.merge(delta)
+
+    def __eq__(self, other):
+        return isinstance(other, GSet) and self.elements == other.elements
+
+    def __hash__(self):
+        return hash(self.elements)
+
+    def __repr__(self):
+        return f"GSet({set(self.elements)!r})"
+
+
+class ORSet(DeltaReplicatedData, RemovedNodePruning, Generic[A]):
+    """Observed-remove set, add-wins on concurrent add/remove.
+
+    Reference: ORSet.scala — element -> "dot" (a VersionVector naming the
+    add events observed for that element) plus a set-level version vector
+    `vvector` that records every event the whole set has seen. Merge keeps
+    an element present on one side iff its dot is NOT dominated by the other
+    side's vvector (i.e. the other side saw the add and deleted it).
+    Deltas here are full-state (correct, since ORSet merge is idempotent);
+    the reference's op-based AddDeltaOp/RemoveDeltaOp is an optimisation.
+    """
+
+    __slots__ = ("element_map", "vvector", "_delta")
+
+    def __init__(self, element_map: Optional[Dict[A, VersionVector]] = None,
+                 vvector: Optional[VersionVector] = None,
+                 _delta: Optional["ORSet[A]"] = None):
+        self.element_map: Dict[A, VersionVector] = dict(element_map or {})
+        self.vvector = vvector or VersionVector.empty()
+        self._delta = _delta
+
+    @staticmethod
+    def empty() -> "ORSet":
+        return ORSet()
+
+    @property
+    def elements(self) -> FrozenSet[A]:
+        return frozenset(self.element_map)
+
+    def contains(self, e: A) -> bool:
+        return e in self.element_map
+
+    def __contains__(self, e: A) -> bool:
+        return e in self.element_map
+
+    def add(self, node: str, e: A) -> "ORSet[A]":
+        vv = self.vvector.increment(node)
+        dot = VersionVector.one(node, vv.version_at(node))
+        new = dict(self.element_map)
+        new[e] = dot  # fresh dot replaces observed history for e (ORSet.scala add)
+        return ORSet(new, vv, _delta=ORSet(dict(new), vv))
+
+    def remove(self, node: str, e: A) -> "ORSet[A]":
+        new = dict(self.element_map)
+        new.pop(e, None)
+        # delta must carry the full causal context so the remove wins over
+        # the adds it observed
+        return ORSet(new, self.vvector, _delta=ORSet(dict(new), self.vvector))
+
+    def clear(self) -> "ORSet[A]":
+        return ORSet({}, self.vvector, _delta=ORSet({}, self.vvector))
+
+    @staticmethod
+    def _merge_dots(d1: VersionVector, d2: VersionVector) -> VersionVector:
+        return d1.merge(d2)
+
+    def merge(self, other: "ORSet[A]") -> "ORSet[A]":
+        merged: Dict[A, VersionVector] = {}
+        for e in set(self.element_map) | set(other.element_map):
+            mine, theirs = self.element_map.get(e), other.element_map.get(e)
+            if mine is not None and theirs is not None:
+                merged[e] = self._merge_dots(mine, theirs)
+            elif mine is not None:
+                # present only here: keep iff other hasn't observed (and
+                # hence removed) every event in the dot
+                if not self._dominated(mine, other.vvector):
+                    merged[e] = mine
+            else:
+                if not self._dominated(theirs, self.vvector):  # type: ignore[arg-type]
+                    merged[e] = theirs  # type: ignore[assignment]
+        return ORSet(merged, self.vvector.merge(other.vvector), self._delta)
+
+    @staticmethod
+    def _dominated(dot: VersionVector, vv: VersionVector) -> bool:
+        return all(vv.version_at(n) >= dot.version_at(n) for n in dot.nodes())
+
+    @property
+    def delta(self) -> Optional["ORSet[A]"]:
+        return self._delta
+
+    def reset_delta(self) -> "ORSet[A]":
+        return ORSet(self.element_map, self.vvector)
+
+    def merge_delta(self, delta: "ORSet[A]") -> "ORSet[A]":
+        return self.merge(delta)
+
+    def modified_by_nodes(self) -> FrozenSet[str]:
+        return frozenset(self.vvector.nodes())
+
+    def prune(self, removed: str, collapse_into: str) -> "ORSet[A]":
+        new: Dict[A, VersionVector] = {}
+        for e, dot in self.element_map.items():
+            new[e] = dot.prune(removed, collapse_into) if dot.contains(removed) else dot
+        return ORSet(new, self.vvector.prune(removed, collapse_into))
+
+    def prune_cleanup(self, removed: str) -> "ORSet[A]":
+        """Drop `removed` from the vvector and every dot (stale replicas
+        gossiping after the prune). Elements whose only add events came from
+        `removed` are dropped too — the pruned copy carries them re-dotted
+        under the collapse target, so the merge restores them."""
+        if removed not in self.vvector.nodes() and not any(
+                dot.contains(removed) for dot in self.element_map.values()):
+            return self
+        new: Dict[A, VersionVector] = {}
+        for e, dot in self.element_map.items():
+            if dot.contains(removed):
+                cleaned = VersionVector({n: v for n, v in dot.versions.items()
+                                         if n != removed})
+                if not cleaned.is_empty():
+                    new[e] = cleaned
+            else:
+                new[e] = dot
+        vv = VersionVector({n: v for n, v in self.vvector.versions.items()
+                            if n != removed})
+        return ORSet(new, vv)
+
+    def __eq__(self, other):
+        return (isinstance(other, ORSet)
+                and self.element_map == other.element_map
+                and self.vvector == other.vvector)
+
+    def __hash__(self):
+        return hash((frozenset(self.element_map.items()), self.vvector))
+
+    def __repr__(self):
+        return f"ORSet({set(self.element_map)!r})"
+
+
+# -- registers & flag -------------------------------------------------------
+
+
+class Flag(ReplicatedData):
+    """Boolean that can only go False -> True (reference: Flag.scala)."""
+
+    __slots__ = ("enabled",)
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+
+    @staticmethod
+    def empty() -> "Flag":
+        return Flag(False)
+
+    def switch_on(self) -> "Flag":
+        return Flag(True)
+
+    def merge(self, other: "Flag") -> "Flag":
+        return Flag(self.enabled or other.enabled)
+
+    def __eq__(self, other):
+        return isinstance(other, Flag) and self.enabled == other.enabled
+
+    def __hash__(self):
+        return hash(self.enabled)
+
+    def __repr__(self):
+        return f"Flag({self.enabled})"
+
+
+class LWWRegister(ReplicatedData, Generic[A]):
+    """Last-writer-wins register (reference: LWWRegister.scala — timestamp
+    with node-id tiebreak; pluggable clock for e.g. monotonically increasing
+    version semantics)."""
+
+    __slots__ = ("node", "value", "timestamp")
+
+    DefaultClock: Callable[[int, Any], int] = staticmethod(
+        lambda current, _value: max(int(time.time() * 1e6), current + 1))
+
+    def __init__(self, node: str, value: A, timestamp: int):
+        self.node = node
+        self.value = value
+        self.timestamp = timestamp
+
+    @staticmethod
+    def create(node: str, value: A,
+               clock: Optional[Callable[[int, Any], int]] = None) -> "LWWRegister[A]":
+        clock = clock or LWWRegister.DefaultClock
+        return LWWRegister(node, value, clock(0, value))
+
+    def with_value(self, node: str, value: A,
+                   clock: Optional[Callable[[int, Any], int]] = None) -> "LWWRegister[A]":
+        clock = clock or LWWRegister.DefaultClock
+        return LWWRegister(node, value, clock(self.timestamp, value))
+
+    def merge(self, other: "LWWRegister[A]") -> "LWWRegister[A]":
+        if other.timestamp > self.timestamp:
+            return other
+        if other.timestamp == self.timestamp and other.node < self.node:
+            return other
+        return self
+
+    def __eq__(self, other):
+        return (isinstance(other, LWWRegister) and self.node == other.node
+                and self.value == other.value and self.timestamp == other.timestamp)
+
+    def __hash__(self):
+        return hash((self.node, self.timestamp))
+
+    def __repr__(self):
+        return f"LWWRegister({self.value!r} @ {self.timestamp} by {self.node})"
+
+
+# -- maps -------------------------------------------------------------------
+
+
+class ORMap(DeltaReplicatedData, RemovedNodePruning, Generic[A]):
+    """Observed-remove map: ORSet of keys + per-key ReplicatedData values
+    merged recursively (reference: ORMap.scala)."""
+
+    __slots__ = ("keys", "entries", "_delta")
+
+    def __init__(self, keys: Optional[ORSet] = None,
+                 entries: Optional[Dict[Any, ReplicatedData]] = None,
+                 _delta: Optional["ORMap"] = None):
+        self.keys = keys or ORSet()
+        self.entries: Dict[Any, ReplicatedData] = dict(entries or {})
+        self._delta = _delta
+
+    @staticmethod
+    def empty() -> "ORMap":
+        return ORMap()
+
+    def get(self, key) -> Optional[ReplicatedData]:
+        return self.entries.get(key)
+
+    def contains(self, key) -> bool:
+        return key in self.entries
+
+    def __contains__(self, key) -> bool:
+        return key in self.entries
+
+    def put(self, node: str, key, value: ReplicatedData) -> "ORMap":
+        new_keys = self.keys.add(node, key)
+        entries = dict(self.entries)
+        entries[key] = value
+        out = ORMap(new_keys, entries)
+        out._delta = out  # full-state delta snapshot (ORSet-style)
+        return out
+
+    def updated(self, node: str, key, initial: ReplicatedData,
+                modify: Callable[[ReplicatedData], ReplicatedData]) -> "ORMap":
+        cur = self.entries.get(key, initial)
+        return self.put(node, key, modify(cur))
+
+    def remove(self, node: str, key) -> "ORMap":
+        new_keys = self.keys.remove(node, key)
+        entries = dict(self.entries)
+        entries.pop(key, None)
+        out = ORMap(new_keys, entries)
+        out._delta = out
+        return out
+
+    def merge(self, other: "ORMap") -> "ORMap":
+        merged_keys = self.keys.merge(other.keys)
+        entries: Dict[Any, ReplicatedData] = {}
+        for key in merged_keys.elements:
+            mine, theirs = self.entries.get(key), other.entries.get(key)
+            if mine is not None and theirs is not None:
+                entries[key] = mine.merge(theirs)
+            elif mine is not None:
+                entries[key] = mine
+            elif theirs is not None:
+                entries[key] = theirs
+        return ORMap(merged_keys, entries, self._delta)
+
+    @property
+    def delta(self) -> Optional["ORMap"]:
+        return self._delta
+
+    def reset_delta(self) -> "ORMap":
+        return ORMap(self.keys.reset_delta(), self.entries)
+
+    def merge_delta(self, delta: "ORMap") -> "ORMap":
+        return self.merge(delta)
+
+    def modified_by_nodes(self) -> FrozenSet[str]:
+        out = set(self.keys.modified_by_nodes())
+        for v in self.entries.values():
+            if isinstance(v, RemovedNodePruning):
+                out |= v.modified_by_nodes()
+        return frozenset(out)
+
+    def prune(self, removed: str, collapse_into: str) -> "ORMap":
+        entries = {
+            k: (v.prune(removed, collapse_into)
+                if isinstance(v, RemovedNodePruning) and v.needs_pruning_from(removed)
+                else v)
+            for k, v in self.entries.items()}
+        return ORMap(self.keys.prune(removed, collapse_into), entries)
+
+    def prune_cleanup(self, removed: str) -> "ORMap":
+        entries = {
+            k: (v.prune_cleanup(removed) if isinstance(v, RemovedNodePruning) else v)
+            for k, v in self.entries.items()}
+        return ORMap(self.keys.prune_cleanup(removed), entries)
+
+    def __eq__(self, other):
+        return (isinstance(other, ORMap) and self.keys == other.keys
+                and self.entries == other.entries)
+
+    def __hash__(self):
+        return hash((self.keys, frozenset(self.entries)))
+
+    def __repr__(self):
+        return f"ORMap({dict(self.entries)!r})"
+
+
+class ORMultiMap(ReplicatedData, Generic[A]):
+    """key -> ORSet of values (reference: ORMultiMap.scala)."""
+
+    __slots__ = ("underlying",)
+
+    def __init__(self, underlying: Optional[ORMap] = None):
+        self.underlying = underlying or ORMap()
+
+    @staticmethod
+    def empty() -> "ORMultiMap":
+        return ORMultiMap()
+
+    def get(self, key) -> FrozenSet:
+        s = self.underlying.get(key)
+        return s.elements if isinstance(s, ORSet) else frozenset()
+
+    def contains(self, key) -> bool:
+        return key in self.underlying
+
+    @property
+    def entries(self) -> Dict[Any, FrozenSet]:
+        return {k: v.elements for k, v in self.underlying.entries.items()
+                if isinstance(v, ORSet)}
+
+    def add_binding(self, node: str, key, value) -> "ORMultiMap":
+        return ORMultiMap(self.underlying.updated(
+            node, key, ORSet(), lambda s: s.add(node, value)))
+
+    def remove_binding(self, node: str, key, value) -> "ORMultiMap":
+        cur = self.underlying.get(key)
+        if not isinstance(cur, ORSet) or value not in cur:
+            return self
+        new_set = cur.remove(node, value)
+        if not new_set.element_map:
+            return ORMultiMap(self.underlying.remove(node, key))
+        return ORMultiMap(self.underlying.put(node, key, new_set))
+
+    def replace_binding(self, node: str, key, old, new) -> "ORMultiMap":
+        if old == new:  # guard: add-then-remove of the same element would
+            return self  # observe the fresh dot and delete the binding
+        return self.add_binding(node, key, new).remove_binding(node, key, old)
+
+    def put(self, node: str, key, values) -> "ORMultiMap":
+        s = ORSet()
+        for v in values:
+            s = s.add(node, v)
+        return ORMultiMap(self.underlying.put(node, key, s))
+
+    def remove(self, node: str, key) -> "ORMultiMap":
+        return ORMultiMap(self.underlying.remove(node, key))
+
+    def merge(self, other: "ORMultiMap") -> "ORMultiMap":
+        return ORMultiMap(self.underlying.merge(other.underlying))
+
+    def __eq__(self, other):
+        return isinstance(other, ORMultiMap) and self.underlying == other.underlying
+
+    def __hash__(self):
+        return hash(self.underlying)
+
+    def __repr__(self):
+        return f"ORMultiMap({self.entries!r})"
+
+
+class PNCounterMap(ReplicatedData):
+    """key -> PNCounter (reference: PNCounterMap.scala)."""
+
+    __slots__ = ("underlying",)
+
+    def __init__(self, underlying: Optional[ORMap] = None):
+        self.underlying = underlying or ORMap()
+
+    @staticmethod
+    def empty() -> "PNCounterMap":
+        return PNCounterMap()
+
+    def get(self, key) -> Optional[int]:
+        c = self.underlying.get(key)
+        return c.value if isinstance(c, PNCounter) else None
+
+    @property
+    def entries(self) -> Dict[Any, int]:
+        return {k: v.value for k, v in self.underlying.entries.items()
+                if isinstance(v, PNCounter)}
+
+    def increment(self, node: str, key, n: int = 1) -> "PNCounterMap":
+        return PNCounterMap(self.underlying.updated(
+            node, key, PNCounter(), lambda c: c.increment(node, n)))
+
+    def decrement(self, node: str, key, n: int = 1) -> "PNCounterMap":
+        return PNCounterMap(self.underlying.updated(
+            node, key, PNCounter(), lambda c: c.decrement(node, n)))
+
+    def remove(self, node: str, key) -> "PNCounterMap":
+        return PNCounterMap(self.underlying.remove(node, key))
+
+    def merge(self, other: "PNCounterMap") -> "PNCounterMap":
+        return PNCounterMap(self.underlying.merge(other.underlying))
+
+    def __eq__(self, other):
+        return isinstance(other, PNCounterMap) and self.underlying == other.underlying
+
+    def __hash__(self):
+        return hash(self.underlying)
+
+    def __repr__(self):
+        return f"PNCounterMap({self.entries!r})"
+
+
+class LWWMap(ReplicatedData, Generic[A]):
+    """key -> LWWRegister (reference: LWWMap.scala)."""
+
+    __slots__ = ("underlying",)
+
+    def __init__(self, underlying: Optional[ORMap] = None):
+        self.underlying = underlying or ORMap()
+
+    @staticmethod
+    def empty() -> "LWWMap":
+        return LWWMap()
+
+    def get(self, key):
+        r = self.underlying.get(key)
+        return r.value if isinstance(r, LWWRegister) else None
+
+    def contains(self, key) -> bool:
+        return key in self.underlying
+
+    @property
+    def entries(self) -> Dict[Any, Any]:
+        return {k: v.value for k, v in self.underlying.entries.items()
+                if isinstance(v, LWWRegister)}
+
+    def put(self, node: str, key, value,
+            clock: Optional[Callable[[int, Any], int]] = None) -> "LWWMap":
+        cur = self.underlying.get(key)
+        reg = (cur.with_value(node, value, clock) if isinstance(cur, LWWRegister)
+               else LWWRegister.create(node, value, clock))
+        return LWWMap(self.underlying.put(node, key, reg))
+
+    def remove(self, node: str, key) -> "LWWMap":
+        return LWWMap(self.underlying.remove(node, key))
+
+    def merge(self, other: "LWWMap") -> "LWWMap":
+        return LWWMap(self.underlying.merge(other.underlying))
+
+    def __eq__(self, other):
+        return isinstance(other, LWWMap) and self.underlying == other.underlying
+
+    def __hash__(self):
+        return hash(self.underlying)
+
+    def __repr__(self):
+        return f"LWWMap({self.entries!r})"

@@ -1,0 +1,384 @@
+"""ddata tests — modeled on the reference's unit specs
+(akka-distributed-data/src/test/scala: GCounterSpec, PNCounterSpec, ORSetSpec,
+ORMapSpec, LWWRegisterSpec, VersionVectorSpec) and multi-jvm ReplicatorSpec,
+run over the in-proc transport; tensor-bank kernels on the virtual 8-dev mesh."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from akka_tpu import ActorSystem
+from akka_tpu.cluster import Cluster
+from akka_tpu.ddata import (Changed, Delete, DeleteSuccess, DataDeleted, Deleted,
+                            DistributedData, Flag, GCounter, Get, GetDataDeleted,
+                            GetSuccess, GSet, Key, LWWMap, LWWRegister, NotFound,
+                            ORMap, ORMultiMap, ORSet, Ordering, PNCounter,
+                            PNCounterMap, ReadAll, ReadLocal, ReadMajority,
+                            Subscribe, Update, UpdateSuccess, VersionVector,
+                            WriteAll, WriteLocal, WriteMajority, tensor)
+from akka_tpu.ddata.durable import DurableStore
+from akka_tpu.remote.transport import InProcTransport
+from akka_tpu.testkit import TestProbe, await_condition
+
+N1, N2, N3 = "n1", "n2", "n3"
+
+FAST = {"akka": {"actor": {"provider": "cluster"},
+                 "stdout-loglevel": "OFF", "log-dead-letters": 0,
+                 "remote": {"transport": "inproc",
+                            "canonical": {"hostname": "local", "port": 0}},
+                 "cluster": {"gossip-interval": "0.05s",
+                             "leader-actions-interval": "0.05s",
+                             "unreachable-nodes-reaper-interval": "0.1s",
+                             "failure-detector": {
+                                 "heartbeat-interval": "0.1s",
+                                 "acceptable-heartbeat-pause": "2s"},
+                             "distributed-data": {
+                                 "gossip-interval": "0.1s",
+                                 "notify-subscribers-interval": "0.05s",
+                                 "pruning-interval": "0.3s",
+                                 "delta-crdt": {
+                                     "delta-propagation-interval": "0.05s"}}}}}
+
+
+# -- version vector ----------------------------------------------------------
+
+def test_version_vector_ordering():
+    v1 = VersionVector.empty().increment(N1)
+    v2 = v1.increment(N2)
+    assert v1.compare_to(v2) == Ordering.BEFORE
+    assert v2.compare_to(v1) == Ordering.AFTER
+    assert v1.compare_to(v1) == Ordering.SAME
+    a = VersionVector.empty().increment(N1)
+    b = VersionVector.empty().increment(N2)
+    assert a.compare_to(b) == Ordering.CONCURRENT
+    m = a.merge(b)
+    assert m.is_after(a) and m.is_after(b)
+
+
+# -- counters ----------------------------------------------------------------
+
+def test_gcounter_merge_idempotent_commutative():
+    a = GCounter.empty().increment(N1, 3)
+    b = GCounter.empty().increment(N2, 5)
+    assert a.merge(b).value == 8
+    assert b.merge(a).value == 8
+    assert a.merge(b).merge(b).value == 8  # idempotent
+    # concurrent increments on the same node: max wins (state-based)
+    a2 = a.increment(N1, 2)
+    assert a2.merge(a).value == 5
+
+    with pytest.raises(ValueError):
+        a.increment(N1, -1)
+
+
+def test_gcounter_delta():
+    a = GCounter.empty().increment(N1, 1).increment(N1, 2)
+    d = a.delta
+    assert d is not None and d.value == 3
+    other = GCounter.empty().increment(N2, 7)
+    assert other.merge_delta(d).value == 10
+    assert a.reset_delta().delta is None
+
+
+def test_pncounter():
+    c = PNCounter.empty().increment(N1, 10).decrement(N1, 3).decrement(N2, 2)
+    assert c.value == 5
+    other = PNCounter.empty().increment(N2, 1)
+    assert c.merge(other).value == 6
+    # prune collapses removed node's contributions
+    pruned = c.prune(N2, N1)
+    assert pruned.value == c.value
+    assert N2 not in pruned.modified_by_nodes()
+
+
+# -- sets --------------------------------------------------------------------
+
+def test_gset():
+    a = GSet.empty().add("x").add("y")
+    b = GSet.empty().add("z")
+    m = a.merge(b)
+    assert m.elements == {"x", "y", "z"}
+    assert "x" in m
+
+
+def test_orset_add_remove():
+    s = ORSet.empty().add(N1, "a").add(N1, "b").remove(N1, "a")
+    assert s.elements == {"b"}
+    assert s.merge(s).elements == {"b"}
+
+
+def test_orset_add_wins_over_concurrent_remove():
+    base = ORSet.empty().add(N1, "x")
+    # replica 1 removes x; replica 2 concurrently re-adds x
+    r1 = base.remove(N1, "x")
+    r2 = base.add(N2, "x")
+    assert r1.merge(r2).elements == {"x"}
+    assert r2.merge(r1).elements == {"x"}
+
+
+def test_orset_remove_propagates():
+    base = ORSet.empty().add(N1, "x").add(N1, "y")
+    removed = base.remove(N1, "x")
+    # replica that only saw the adds converges to the remove
+    assert base.merge(removed).elements == {"y"}
+    assert removed.merge(base).elements == {"y"}
+
+
+def test_orset_prune():
+    s = ORSet.empty().add(N1, "a").add(N2, "b")
+    p = s.prune(N2, N1)
+    assert p.elements == {"a", "b"}
+    assert N2 not in p.modified_by_nodes()
+
+
+# -- registers, flag, maps ---------------------------------------------------
+
+def test_flag_and_lww():
+    assert Flag.empty().merge(Flag.empty().switch_on()).enabled
+    r1 = LWWRegister.create(N1, "v1", clock=lambda c, v: 1)
+    r2 = r1.with_value(N2, "v2", clock=lambda c, v: 2)
+    assert r1.merge(r2).value == "v2"
+    assert r2.merge(r1).value == "v2"
+    # same timestamp: lowest node id wins (deterministic tiebreak)
+    ra = LWWRegister(N1, "a", 5)
+    rb = LWWRegister(N2, "b", 5)
+    assert ra.merge(rb).value == "a"
+    assert rb.merge(ra).value == "a"
+
+
+def test_ormap_and_friends():
+    m = ORMap.empty().put(N1, "k1", GCounter.empty().increment(N1, 2))
+    m2 = ORMap.empty().put(N2, "k1", GCounter.empty().increment(N2, 3))
+    merged = m.merge(m2)
+    assert merged.get("k1").value == 5
+    removed = merged.remove(N1, "k1")
+    assert "k1" not in removed
+
+    mm = (ORMultiMap.empty().add_binding(N1, "k", 1).add_binding(N1, "k", 2)
+          .remove_binding(N1, "k", 1))
+    assert mm.get("k") == {2}
+
+    pm = PNCounterMap.empty().increment(N1, "a", 3).decrement(N1, "a", 1)
+    assert pm.get("a") == 2
+    assert pm.merge(PNCounterMap.empty().increment(N2, "a", 1)).get("a") == 3
+
+    lm = LWWMap.empty().put(N1, "k", "v", clock=lambda c, v: 1)
+    lm2 = lm.put(N2, "k", "w", clock=lambda c, v: 2)
+    assert lm.merge(lm2).get("k") == "w"
+
+
+# -- tensor banks ------------------------------------------------------------
+
+def test_tensor_gcounter_bank():
+    n_keys, n_nodes = 16, 4
+    a = jnp.zeros((n_keys, n_nodes), jnp.uint32)
+    a = tensor.gcounter_increment(a, 0, jnp.array([1, 1, 5]), jnp.array([2, 3, 7]))
+    b = jnp.zeros((n_keys, n_nodes), jnp.uint32)
+    b = tensor.gcounter_increment(b, 2, jnp.array([1]), jnp.array([10]))
+    m = tensor.gcounter_merge(a, b)
+    vals = tensor.gcounter_value(m)
+    assert int(vals[1]) == 15 and int(vals[5]) == 7
+    # idempotent + commutative
+    assert (tensor.gcounter_merge(m, a) == m).all()
+    assert (tensor.gcounter_merge(b, a) == m).all()
+
+
+def test_tensor_converge_over_mesh():
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs virtual multi-device mesh")
+    from jax.sharding import Mesh
+    n = 4
+    mesh = Mesh(devs[:n], ("replica",))
+    n_keys, n_nodes = 8, n
+    # each replica has incremented its own node column locally
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    host = np.zeros((n, n_keys, n_nodes), np.uint32)
+    for r in range(n):
+        host[r, :, r] = r + 1
+    stacked = jax.device_put(jnp.asarray(host),
+                             NamedSharding(mesh, P("replica")))
+    converged = tensor.converge_over_mesh(stacked, mesh)
+    out = np.asarray(converged)
+    # every replica sees the join: column r == r+1 for all keys
+    for r in range(n):
+        assert (out[r] == out[0]).all()
+        assert (out[0][:, r] == r + 1).all()
+    # value = sum over node columns
+    assert (np.asarray(tensor.gcounter_value(converged[0])) ==
+            sum(range(1, n + 1))).all()
+
+
+# -- durable store -----------------------------------------------------------
+
+def test_durable_store_roundtrip(tmp_path):
+    store = DurableStore(str(tmp_path))
+    g = GCounter.empty().increment(N1, 42)
+    store.store("counter", g)
+    store.store("set", GSet.empty().add("x"))
+    loaded = DurableStore(str(tmp_path)).load_all()
+    assert loaded["counter"].value == 42
+    assert loaded["set"].elements == {"x"}
+    store.delete("counter")
+    assert "counter" not in DurableStore(str(tmp_path)).load_all()
+
+
+# -- replicator (multi-node over in-proc transport) --------------------------
+
+@pytest.fixture()
+def ddata_nodes():
+    InProcTransport.fault_injector.reset()
+    systems = [ActorSystem.create(f"dd{i}", FAST) for i in range(3)]
+    clusters = [Cluster.get(s) for s in systems]
+    first = str(systems[0].provider.local_address)
+    for c in clusters:
+        c.join(first)
+    await_condition(
+        lambda: all(len([m for m in c.state.members
+                         if m.status.value == "Up"]) == 3 for c in clusters),
+        max_time=10.0)
+    dd = [DistributedData.get(s) for s in systems]
+    yield systems, dd
+    for s in systems:
+        s.terminate()
+    for s in systems:
+        s.await_termination(10.0)
+    InProcTransport.fault_injector.reset()
+
+
+def _node_id(system):
+    """uid-qualified CRDT node id (what DistributedData.self_unique_address
+    exposes) — the id pruning recognises after the member is removed."""
+    from akka_tpu.ddata.replicator import unique_node_id
+    return unique_node_id(Cluster.get(system).self_unique_address)
+
+
+def test_replicator_update_and_gossip_convergence(ddata_nodes):
+    systems, dd = ddata_nodes
+    key = Key("counter")
+    probe = TestProbe(systems[0])
+    nid = _node_id(systems[0])
+    dd[0].replicator.tell(
+        Update(key, GCounter.empty(), WriteLocal(),
+               lambda c: c.increment(nid, 5)), probe.ref)
+    assert isinstance(probe.expect_msg_class(UpdateSuccess, 3.0), UpdateSuccess)
+
+    # gossip/delta propagates to the other nodes
+    def replicated_everywhere():
+        oks = []
+        for i in (1, 2):
+            p = TestProbe(systems[i])
+            dd[i].replicator.tell(Get(key, ReadLocal()), p.ref)
+            m = p.receive_one(2.0)
+            oks.append(isinstance(m, GetSuccess) and m.data.value == 5)
+        return all(oks)
+    await_condition(replicated_everywhere, max_time=10.0)
+
+
+def test_replicator_write_majority_read_majority(ddata_nodes):
+    systems, dd = ddata_nodes
+    key = Key("orset")
+    p0 = TestProbe(systems[0])
+    nid0 = _node_id(systems[0])
+    dd[0].replicator.tell(
+        Update(key, ORSet.empty(), WriteMajority(3.0),
+               lambda s: s.add(nid0, "alpha")), p0.ref)
+    p0.expect_msg_class(UpdateSuccess, 5.0)
+
+    # WriteMajority(3 nodes) = self + 1 remote, so a majority read from any
+    # node must observe the element
+    p1 = TestProbe(systems[1])
+    dd[1].replicator.tell(Get(key, ReadMajority(3.0)), p1.ref)
+    got = p1.expect_msg_class(GetSuccess, 5.0)
+    assert "alpha" in got.data.elements
+
+
+def test_replicator_write_all_read_local(ddata_nodes):
+    systems, dd = ddata_nodes
+    key = Key("flag")
+    p = TestProbe(systems[2])
+    dd[2].replicator.tell(
+        Update(key, Flag.empty(), WriteAll(5.0), lambda f: f.switch_on()), p.ref)
+    p.expect_msg_class(UpdateSuccess, 6.0)
+    for i in range(3):
+        pi = TestProbe(systems[i])
+        dd[i].replicator.tell(Get(key, ReadLocal()), pi.ref)
+        assert pi.expect_msg_class(GetSuccess, 2.0).data.enabled
+
+
+def test_replicator_subscribe_changed(ddata_nodes):
+    systems, dd = ddata_nodes
+    key = Key("subbed")
+    sub = TestProbe(systems[1])
+    dd[1].replicator.tell(Subscribe(key, sub.ref), None)
+    nid0 = _node_id(systems[0])
+    p = TestProbe(systems[0])
+    dd[0].replicator.tell(
+        Update(key, PNCounter.empty(), WriteLocal(),
+               lambda c: c.increment(nid0, 9)), p.ref)
+    p.expect_msg_class(UpdateSuccess, 3.0)
+    changed = sub.expect_msg_class(Changed, 10.0)
+    assert changed.key == key and changed.data.value == 9
+
+
+def test_replicator_get_notfound_and_delete(ddata_nodes):
+    systems, dd = ddata_nodes
+    p = TestProbe(systems[0])
+    dd[0].replicator.tell(Get(Key("missing"), ReadLocal()), p.ref)
+    assert isinstance(p.receive_one(2.0), NotFound)
+
+    key = Key("doomed")
+    nid = _node_id(systems[0])
+    dd[0].replicator.tell(
+        Update(key, GCounter.empty(), WriteAll(5.0),
+               lambda c: c.increment(nid, 1)), p.ref)
+    p.expect_msg_class(UpdateSuccess, 6.0)
+    dd[0].replicator.tell(Delete(key, WriteAll(5.0)), p.ref)
+    p.expect_msg_class(DeleteSuccess, 6.0)
+    # all nodes see the tombstone; further updates rejected
+    for i in range(3):
+        pi = TestProbe(systems[i])
+        dd[i].replicator.tell(Get(key, ReadLocal()), pi.ref)
+        assert isinstance(pi.receive_one(2.0), GetDataDeleted)
+    dd[0].replicator.tell(Delete(key, WriteLocal()), p.ref)
+    assert isinstance(p.receive_one(2.0), DataDeleted)
+
+
+def test_replicator_prunes_removed_node_without_double_count(ddata_nodes):
+    """Reference semantics (PruningState): after a member is removed, the
+    leader collapses its CRDT contributions into itself; stale copies must
+    not resurrect the removed node's entries (no double count)."""
+    systems, dd = ddata_nodes
+    key = Key("pruned-counter")
+    # every node contributes 1 -> value 3, replicated everywhere
+    for i in range(3):
+        p = TestProbe(systems[i])
+        nid = _node_id(systems[i])
+        dd[i].replicator.tell(
+            Update(key, GCounter.empty(), WriteAll(5.0),
+                   lambda c, nid=nid: c.increment(nid, 1)), p.ref)
+        p.expect_msg_class(UpdateSuccess, 6.0)
+
+    # node 2 leaves the cluster for good
+    gone = _node_id(systems[2])
+    systems[2].terminate()
+    systems[2].await_termination(10.0)
+    Cluster.get(systems[0]).down(gone)
+    await_condition(
+        lambda: all(gone not in [str(m.address) for m in
+                                 Cluster.get(s).state.members]
+                    for s in systems[:2]), max_time=10.0)
+
+    def pruned_everywhere():
+        ok = []
+        for i in (0, 1):
+            p = TestProbe(systems[i])
+            dd[i].replicator.tell(Get(key, ReadLocal()), p.ref)
+            m = p.receive_one(2.0)
+            ok.append(isinstance(m, GetSuccess) and m.data.value == 3
+                      and gone not in m.data.modified_by_nodes())
+        return all(ok)
+    await_condition(pruned_everywhere, max_time=15.0)
