@@ -1,0 +1,60 @@
+"""Persistence (event sourcing): akka-persistence equivalent (SURVEY.md §2.8).
+
+Classic PersistentActor with persist/persistAsync + recovery, typed
+EventSourcedBehavior with the Effect API, journal/snapshot plugin SPI with
+in-mem and append-only-file implementations, AtLeastOnceDelivery,
+persistence-query, a programmable-failure testkit journal, TCK compliance
+suites, and TPU slab snapshots (orbax/npz) for the batched runtime.
+"""
+
+from .messages import (AtomicWrite, DeleteMessagesFailure,  # noqa: F401
+                       DeleteMessagesSuccess, DeleteSnapshotsSuccess,
+                       DeleteSnapshotSuccess, LoadSnapshot, LoadSnapshotResult,
+                       PersistentRepr, Recovery, RecoveryCompleted,
+                       RecoverySuccess, ReplayedMessage, ReplayMessages,
+                       SaveSnapshot, SaveSnapshotFailure, SaveSnapshotSuccess,
+                       SelectedSnapshot, SnapshotMetadata, SnapshotOffer,
+                       SnapshotSelectionCriteria, Tagged, WriteMessages)
+from .journal import (FileJournal, InMemJournal, JournalActor,  # noqa: F401
+                      JournalPlugin, SharedInMemStore)
+from .snapshot import (InMemSnapshotStore, LocalSnapshotStore,  # noqa: F401
+                       SnapshotPlugin, SnapshotStoreActor)
+from .persistence import (JOURNAL_FILE, JOURNAL_INMEM,  # noqa: F401
+                          Persistence, RecoveryPermitter, SNAPSHOT_INMEM,
+                          SNAPSHOT_LOCAL)
+from .eventsourced import PersistentActor  # noqa: F401
+from .at_least_once import (AtLeastOnceDelivery,  # noqa: F401
+                            AtLeastOnceDeliverySnapshot,
+                            MaxUnconfirmedMessagesExceededException,
+                            UnconfirmedDelivery, UnconfirmedWarning)
+from .typed import (Effect, EventSourcedBehavior,  # noqa: F401
+                    PersistenceId, RetentionCriteria)
+from .query import (EventEnvelope, EventStream, NoOffset,  # noqa: F401
+                    PersistenceQuery, ReadJournal, Sequence)
+from .testkit import (FailIf, FailNextN, PassAll,  # noqa: F401
+                      PersistenceTestKitJournal, ProcessingPolicy,
+                      RejectNextN, journal_tck, snapshot_store_tck)
+from . import slab_snapshot  # noqa: F401
+
+__all__ = [
+    "PersistentRepr", "AtomicWrite", "Tagged", "Recovery",
+    "RecoveryCompleted", "SnapshotOffer", "SnapshotMetadata",
+    "SnapshotSelectionCriteria", "SelectedSnapshot",
+    "SaveSnapshotSuccess", "SaveSnapshotFailure", "DeleteMessagesSuccess",
+    "JournalPlugin", "InMemJournal", "FileJournal", "JournalActor",
+    "SharedInMemStore",
+    "SnapshotPlugin", "InMemSnapshotStore", "LocalSnapshotStore",
+    "SnapshotStoreActor",
+    "Persistence", "RecoveryPermitter",
+    "JOURNAL_INMEM", "JOURNAL_FILE", "SNAPSHOT_INMEM", "SNAPSHOT_LOCAL",
+    "PersistentActor",
+    "AtLeastOnceDelivery", "AtLeastOnceDeliverySnapshot",
+    "UnconfirmedDelivery", "UnconfirmedWarning",
+    "MaxUnconfirmedMessagesExceededException",
+    "EventSourcedBehavior", "Effect", "PersistenceId", "RetentionCriteria",
+    "PersistenceQuery", "ReadJournal", "EventEnvelope", "EventStream",
+    "Sequence", "NoOffset",
+    "PersistenceTestKitJournal", "ProcessingPolicy", "PassAll", "FailNextN",
+    "RejectNextN", "FailIf", "journal_tck", "snapshot_store_tck",
+    "slab_snapshot",
+]

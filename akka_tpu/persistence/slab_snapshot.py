@@ -1,0 +1,124 @@
+"""Checkpoint/resume of the TPU-batched runtime: snapshot the SoA slabs.
+
+SURVEY.md §2.10 item 8 / §5 checkpoint-resume: "snapshot = dump of SoA state
+tensors (orbax), journal = append-only host log of message batches; replay =
+re-running jitted steps". This module is that snapshot half for
+akka_tpu.batched.BatchedSystem: every device-resident slab (per-column actor
+state, behavior ids, alive mask, inbox tensors, step counter) is serialized
+as one pytree.
+
+Uses orbax-checkpoint when importable (async-friendly, TPU-native sharding
+aware) and falls back to a .npz file — the pytree layout is identical, so
+the two formats are feature-equivalent for single-host slabs.
+
+Journal-side replay integration: JournalPlugin stores inbox batches via
+`record_step_batch`, and `replay_steps` re-applies them to a restored system
+— the reference's event replay (persistence/Eventsourced.scala recovery)
+with "event" = one step's message batch.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+_SLAB_KEYS = ("behavior_id", "alive", "step_count", "inbox_dst",
+              "inbox_payload", "inbox_valid")
+
+
+def slab_pytree(system) -> Dict[str, Any]:
+    """Extract the full device state of a BatchedSystem as a pytree."""
+    tree: Dict[str, Any] = {"state": dict(system.state)}
+    for k in _SLAB_KEYS:
+        tree[k] = getattr(system, k)
+    return tree
+
+
+def restore_slab_pytree(system, tree: Dict[str, Any]) -> None:
+    """Load a pytree produced by slab_pytree back into `system` (shapes must
+    match: same capacity/out_degree/payload schema)."""
+    for col, arr in tree["state"].items():
+        cur = system.state.get(col)
+        if cur is not None and tuple(cur.shape) != tuple(arr.shape):
+            raise ValueError(
+                f"slab shape mismatch for state[{col!r}]: "
+                f"{tuple(arr.shape)} vs {tuple(cur.shape)}")
+        system.state[col] = jnp.asarray(arr)
+    for k in _SLAB_KEYS:
+        cur = getattr(system, k)
+        arr = tree[k]
+        if hasattr(cur, "shape") and tuple(cur.shape) != tuple(
+                np.asarray(arr).shape):
+            raise ValueError(f"slab shape mismatch for {k}: "
+                             f"{np.asarray(arr).shape} vs {tuple(cur.shape)}")
+        setattr(system, k, jnp.asarray(arr))
+
+
+def _try_orbax():
+    try:
+        import orbax.checkpoint as ocp
+        return ocp
+    except Exception:  # noqa: BLE001 — orbax optional at runtime
+        return None
+
+
+def save_slabs(system, directory: str, step: Optional[int] = None) -> str:
+    """Snapshot `system` under `directory`; returns the checkpoint path."""
+    tree = jax.tree_util.tree_map(np.asarray, slab_pytree(system))
+    ocp = _try_orbax()
+    name = f"slab-{step if step is not None else int(tree['step_count'])}"
+    path = os.path.join(os.path.abspath(directory), name)
+    if ocp is not None:
+        ckpt = ocp.PyTreeCheckpointer()
+        ckpt.save(path, tree, force=True)
+        return path
+    os.makedirs(directory, exist_ok=True)
+    flat = {}
+    for col, arr in tree["state"].items():
+        flat[f"state.{col}"] = arr
+    for k in _SLAB_KEYS:
+        flat[k] = tree[k]
+    np.savez(path + ".npz", **flat)
+    return path + ".npz"
+
+
+def restore_slabs(system, path: str) -> None:
+    """Restore a snapshot written by save_slabs into `system`."""
+    if path.endswith(".npz"):
+        with np.load(path) as data:
+            tree: Dict[str, Any] = {"state": {}}
+            for k in data.files:
+                if k.startswith("state."):
+                    tree["state"][k[len("state."):]] = data[k]
+                else:
+                    tree[k] = data[k]
+        restore_slab_pytree(system, tree)
+        return
+    ocp = _try_orbax()
+    if ocp is None:
+        raise RuntimeError("orbax not available and path is not .npz")
+    tree = ocp.PyTreeCheckpointer().restore(path)
+    restore_slab_pytree(system, tree)
+
+
+def latest_slab_path(directory: str) -> Optional[str]:
+    if not os.path.isdir(directory):
+        return None
+    best, best_step = None, -1
+    for name in os.listdir(directory):
+        if not name.startswith("slab-"):
+            continue
+        stem = name[len("slab-"):]
+        stem = stem[:-4] if stem.endswith(".npz") else stem
+        try:
+            step = int(stem)
+        except ValueError:
+            continue
+        if step > best_step:
+            best, best_step = os.path.join(directory, name), step
+    return best

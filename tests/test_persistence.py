@@ -1,0 +1,462 @@
+"""Persistence tests — modeled on the reference's specs
+(akka-persistence/src/test/scala: PersistentActorSpec, SnapshotSpec,
+AtLeastOnceDeliverySpec, PersistentActorRecoveryTimeoutSpec;
+persistence-tck JournalSpec/SnapshotStoreSpec; persistence-query
+EventsByPersistenceIdSpec/EventsByTagSpec; typed
+EventSourcedBehaviorSpec)."""
+
+import time
+
+import pytest
+
+from akka_tpu import ActorSystem, Props
+from akka_tpu.persistence import (AtLeastOnceDelivery, Effect,
+                                  EventSourcedBehavior, FailNextN,
+                                  FileJournal, InMemJournal,
+                                  InMemSnapshotStore, LocalSnapshotStore,
+                                  NoOffset, Persistence, PersistenceId,
+                                  PersistenceQuery, PersistenceTestKitJournal,
+                                  PersistentActor, RecoveryCompleted,
+                                  RejectNextN, RetentionCriteria,
+                                  SaveSnapshotSuccess, SnapshotOffer, Tagged,
+                                  UnconfirmedWarning, journal_tck,
+                                  slab_snapshot, snapshot_store_tck)
+from akka_tpu.testkit import TestProbe, await_condition
+
+CFG = {"akka": {"stdout-loglevel": "OFF", "log-dead-letters": 0,
+                "persistence": {"snapshot-store": {
+                    "plugin": "akka.persistence.snapshot-store.inmem"}}}}
+
+_sys_counter = [0]
+
+
+@pytest.fixture()
+def system():
+    _sys_counter[0] += 1
+    s = ActorSystem.create(f"persist-test-{_sys_counter[0]}", CFG)
+    yield s
+    s.terminate()
+    s.await_termination(10.0)
+
+
+# -- TCK: every plugin implementation passes the same compliance suite -------
+
+def test_journal_tck_inmem():
+    journal_tck(InMemJournal)
+
+
+def test_journal_tck_file(tmp_path):
+    counter = [0]
+
+    def fresh():
+        counter[0] += 1
+        return FileJournal(str(tmp_path / f"j{counter[0]}"))
+    journal_tck(fresh)
+
+
+def test_journal_tck_testkit_journal():
+    journal_tck(PersistenceTestKitJournal)
+
+
+def test_snapshot_tck_inmem():
+    snapshot_store_tck(InMemSnapshotStore)
+
+
+def test_snapshot_tck_local(tmp_path):
+    counter = [0]
+
+    def fresh():
+        counter[0] += 1
+        return LocalSnapshotStore(str(tmp_path / f"s{counter[0]}"))
+    snapshot_store_tck(fresh)
+
+
+def test_file_journal_survives_reopen(tmp_path):
+    from akka_tpu.persistence import AtomicWrite, PersistentRepr
+    d = str(tmp_path / "jj")
+    j = FileJournal(d)
+    j.write_atomic(AtomicWrite((PersistentRepr("a", 1, "p"),
+                                PersistentRepr("b", 2, "p"))))
+    j2 = FileJournal(d)  # fresh process equivalent
+    got = []
+    j2.replay("p", 1, 2**63 - 1, 2**63 - 1, got.append)
+    assert [r.payload for r in got] == ["a", "b"]
+    assert j2.highest_sequence_nr("p", 0) == 2
+    assert j2.persistence_ids() == ["p"]
+
+
+# -- classic PersistentActor --------------------------------------------------
+
+class Counter(PersistentActor):
+    def __init__(self, pid: str, probe=None):
+        super().__init__()
+        self._pid = pid
+        self.count = 0
+        self.probe = probe
+
+    @property
+    def persistence_id(self) -> str:
+        return self._pid
+
+    def receive_recover(self, message):
+        if isinstance(message, SnapshotOffer):
+            self.count = message.snapshot
+        elif isinstance(message, RecoveryCompleted):
+            if self.probe:
+                self.probe.tell(("recovered", self.count), self.self_ref)
+        elif isinstance(message, int):
+            self.count += message
+        else:
+            return NotImplemented
+
+    def receive_command(self, message):
+        if message == "get":
+            self.sender.tell(self.count, self.self_ref)
+        elif isinstance(message, int):
+            def handler(ev):
+                self.count += ev
+                if self.probe:
+                    self.probe.tell(("persisted", ev, self.count), self.self_ref)
+            self.persist(message, handler)
+        elif message == "snap":
+            self.save_snapshot(self.count)
+        elif isinstance(message, SaveSnapshotSuccess):
+            if self.probe:
+                self.probe.tell(("snapped", message.metadata.sequence_nr),
+                                self.self_ref)
+        else:
+            return NotImplemented
+
+
+def test_persist_and_recover(system):
+    probe = TestProbe(system)
+    ref = system.actor_of(Props.create(Counter, "c1", probe.ref), "c1")
+    assert probe.receive_one(5.0) == ("recovered", 0)
+    for i in (1, 2, 3):
+        ref.tell(i, probe.ref)
+    assert probe.receive_one(5.0) == ("persisted", 1, 1)
+    assert probe.receive_one(5.0) == ("persisted", 2, 3)
+    assert probe.receive_one(5.0) == ("persisted", 3, 6)
+
+    # restart: a fresh incarnation replays the journal
+    system.stop(ref)
+    probe.watch(ref)
+    probe.expect_terminated(ref, 5.0)
+    ref2 = system.actor_of(Props.create(Counter, "c1", probe.ref), "c1b")
+    assert probe.receive_one(5.0) == ("recovered", 6)
+    ref2.tell("get", probe.ref)
+    assert probe.receive_one(5.0) == 6
+
+
+def test_stash_while_persisting_preserves_order(system):
+    """Commands sent while a persist is in flight are processed after the
+    handler (reference Eventsourced stash :218-233)."""
+    order = []
+
+    class Tracker(PersistentActor):
+        @property
+        def persistence_id(self):
+            return "tracker"
+
+        def receive_recover(self, message):
+            pass
+
+        def receive_command(self, message):
+            if message == "a":
+                order.append("cmd-a")
+                self.persist("ev-a", lambda ev: order.append("handler-a"))
+            else:
+                order.append(f"cmd-{message}")
+                self.sender.tell("done", self.self_ref)
+
+    probe = TestProbe(system)
+    ref = system.actor_of(Props.create(Tracker))
+    ref.tell("a", probe.ref)
+    ref.tell("b", probe.ref)  # arrives while ev-a write is in flight
+    probe.expect_msg("done", 5.0)
+    assert order == ["cmd-a", "handler-a", "cmd-b"]
+
+
+def test_snapshot_speeds_recovery(system):
+    probe = TestProbe(system)
+    ref = system.actor_of(Props.create(Counter, "c2", probe.ref))
+    probe.receive_one(5.0)  # recovered
+    for i in range(5):
+        ref.tell(1, probe.ref)
+        probe.receive_one(5.0)
+    ref.tell("snap", probe.ref)
+    assert probe.receive_one(5.0)[0] == "snapped"
+    ref.tell(1, probe.ref)   # one event after the snapshot
+    probe.receive_one(5.0)
+
+    ref2 = system.actor_of(Props.create(Counter, "c2", probe.ref))
+    assert probe.receive_one(5.0) == ("recovered", 6)
+
+
+def test_persist_failure_stops_actor(system):
+    Persistence.register_journal_plugin(
+        "test.failing-journal", lambda sys_, cfg: failing_journal)
+    failing_journal = PersistenceTestKitJournal()
+
+    class Failing(Counter):
+        journal_plugin_id = "test.failing-journal"
+
+    probe = TestProbe(system)
+    ref = system.actor_of(Props.create(Failing, "f1", probe.ref))
+    assert probe.receive_one(5.0) == ("recovered", 0)
+    probe.watch(ref)
+    failing_journal.set_policy(FailNextN(1))
+    ref.tell(1, probe.ref)
+    probe.expect_terminated(ref, 5.0)
+
+
+def test_persist_rejection_keeps_actor_running(system):
+    rejecting = PersistenceTestKitJournal()
+    Persistence.register_journal_plugin(
+        "test.rejecting-journal", lambda sys_, cfg: rejecting)
+
+    class Rejecting(Counter):
+        journal_plugin_id = "test.rejecting-journal"
+
+    probe = TestProbe(system)
+    ref = system.actor_of(Props.create(Rejecting, "r1", probe.ref))
+    assert probe.receive_one(5.0) == ("recovered", 0)
+    rejecting.set_policy(RejectNextN(1))
+    ref.tell(1, probe.ref)     # rejected: no handler call, no state change
+    ref.tell(2, probe.ref)     # accepted
+    assert probe.receive_one(5.0) == ("persisted", 2, 2)
+    ref.tell("get", probe.ref)
+    assert probe.receive_one(5.0) == 2
+
+
+# -- at-least-once delivery ---------------------------------------------------
+
+def test_at_least_once_delivery_redelivers_until_confirm(system):
+    class Sender(AtLeastOnceDelivery):
+        redeliver_interval = 0.2
+
+        def __init__(self, dest):
+            super().__init__()
+            self.dest = dest
+
+        @property
+        def persistence_id(self):
+            return "alod-sender"
+
+        def receive_recover(self, message):
+            pass
+
+        def receive_command(self, message):
+            if message == "send":
+                self.persist("msg-sent", lambda ev: self.deliver(
+                    self.dest, lambda did: ("payload", did)))
+            elif isinstance(message, tuple) and message[0] == "confirm":
+                self.persist(("confirmed", message[1]),
+                             lambda ev: self.confirm_delivery(ev[1]))
+            elif message == "unconfirmed?":
+                self.sender.tell(self.number_of_unconfirmed, self.self_ref)
+
+    probe = TestProbe(system)
+    ref = system.actor_of(Props.create(Sender, probe.ref))
+    ref.tell("send", probe.ref)
+    first = probe.receive_one(5.0)
+    assert first[0] == "payload"
+    did = first[1]
+    # not confirmed -> redelivered
+    second = probe.receive_one(5.0)
+    assert second == first
+    ref.tell(("confirm", did), probe.ref)
+    await_condition(lambda: _unconfirmed(ref, system) == 0, max_time=5.0)
+    probe_quiet = TestProbe(system)
+    time.sleep(0.5)  # no more redeliveries after confirm
+    assert probe.ref is not None
+
+
+def _unconfirmed(ref, system):
+    from akka_tpu.pattern.ask import ask_sync
+    try:
+        return ask_sync(ref, "unconfirmed?", timeout=2.0)
+    except Exception:  # noqa: BLE001
+        return -1
+
+
+# -- typed EventSourcedBehavior ----------------------------------------------
+
+def test_typed_event_sourced_counter(system):
+    probe = TestProbe(system)
+
+    def command_handler(state, cmd):
+        if cmd[0] == "add":
+            return Effect.persist(("added", cmd[1])).then_reply(
+                cmd[2], lambda s: ("total", s))
+        if cmd[0] == "get":
+            return Effect.reply(cmd[1], ("total", state))
+        return Effect.unhandled()
+
+    def event_handler(state, event):
+        if event[0] == "added":
+            return state + event[1]
+        return state
+
+    def make():
+        return EventSourcedBehavior(
+            PersistenceId.of("Counter", "t1"), 0, command_handler,
+            event_handler, retention=RetentionCriteria.snapshot_every_n(100))
+
+    from akka_tpu.typed.adapter import props_from_behavior
+    ref = system.actor_of(props_from_behavior(make()), "typed-counter")
+    ref.tell(("add", 5, probe.ref))
+    assert probe.receive_one(5.0) == ("total", 5)
+    ref.tell(("add", 7, probe.ref))
+    assert probe.receive_one(5.0) == ("total", 12)
+
+    # recovery in a fresh incarnation
+    ref2 = system.actor_of(props_from_behavior(make()), "typed-counter2")
+    ref2.tell(("get", probe.ref))
+    assert probe.receive_one(5.0) == ("total", 12)
+
+
+def test_typed_effect_stop_and_none(system):
+    probe = TestProbe(system)
+
+    def command_handler(state, cmd):
+        if cmd == "stop":
+            return Effect.stop()
+        if cmd == "noop":
+            return Effect.none().then_run(
+                lambda s: probe.ref.tell(("ran", s), None))
+        return Effect.unhandled()
+
+    from akka_tpu.typed.adapter import props_from_behavior
+    beh = EventSourcedBehavior(PersistenceId.of_unique_id("stopper"), 0,
+                               command_handler, lambda s, e: s)
+    ref = system.actor_of(props_from_behavior(beh))
+    ref.tell("noop")
+    assert probe.receive_one(5.0) == ("ran", 0)
+    probe.watch(ref)
+    ref.tell("stop")
+    probe.expect_terminated(ref, 5.0)
+
+
+def test_typed_supervised_restart_rereplays_journal(system):
+    """A supervised restart must re-run recovery from the journal, not reuse
+    the crashed incarnation's in-memory state (Running.scala restart)."""
+    from akka_tpu.typed import Behaviors, SupervisorStrategy
+    from akka_tpu.typed.adapter import props_from_behavior
+    probe = TestProbe(system)
+
+    def ch(state, cmd):
+        if cmd[0] == "add":
+            return Effect.persist(("added", cmd[1])).then_reply(
+                cmd[2], lambda s: ("total", s))
+        if cmd[0] == "boom":
+            raise RuntimeError("kaboom")
+        if cmd[0] == "get":
+            return Effect.reply(cmd[1], ("total", state))
+        return Effect.unhandled()
+
+    beh = EventSourcedBehavior(PersistenceId.of("Sup", "s1"), 0, ch,
+                               lambda s, e: s + e[1])
+    sup = Behaviors.supervise(beh).on_failure(
+        SupervisorStrategy.restart(), RuntimeError)
+    ref = system.actor_of(props_from_behavior(sup), "sup-es")
+    ref.tell(("add", 3, probe.ref))
+    assert probe.receive_one(5.0) == ("total", 3)
+    ref.tell(("boom",))
+    # post-restart state comes from journal replay, not the crashed instance
+    ref.tell(("get", probe.ref))
+    assert probe.receive_one(5.0) == ("total", 3)
+    ref.tell(("add", 4, probe.ref))
+    assert probe.receive_one(5.0) == ("total", 7)
+
+
+def test_file_journal_atomic_rejection(tmp_path):
+    """An unserializable event in an AtomicWrite must reject the WHOLE batch
+    with nothing written (all-or-nothing contract)."""
+    from akka_tpu.persistence import AtomicWrite, PersistentRepr
+    j = FileJournal(str(tmp_path / "aj"))
+    bad = AtomicWrite((PersistentRepr("fine", 1, "p"),
+                       PersistentRepr(lambda: None, 2, "p")))  # unpicklable
+    assert j.write_atomic(bad) is not None  # rejected
+    got = []
+    j.replay("p", 1, 2**63 - 1, 2**63 - 1, got.append)
+    assert got == [], "rejected batch must leave no events behind"
+    assert j.highest_sequence_nr("p", 0) == 0
+
+
+# -- persistence query --------------------------------------------------------
+
+def test_query_current_and_live(system):
+    probe = TestProbe(system)
+    ref = system.actor_of(Props.create(Counter, "q1", probe.ref))
+    probe.receive_one(5.0)
+    for i in (1, 2):
+        ref.tell(i, probe.ref)
+        probe.receive_one(5.0)
+
+    rj = PersistenceQuery.get(system).read_journal_for()
+    assert "q1" in rj.current_persistence_ids()
+    envs = rj.current_events_by_persistence_id("q1")
+    assert [e.event for e in envs] == [1, 2]
+    assert [e.sequence_nr for e in envs] == [1, 2]
+
+    live = rj.events_by_persistence_id("q1")
+    got = live.drain()
+    assert [e.event for e in got] == [1, 2]
+    ref.tell(9, probe.ref)
+    probe.receive_one(5.0)
+    nxt = live.poll(5.0)
+    assert nxt is not None and nxt.event == 9
+    live.close()
+
+
+def test_query_events_by_tag(system):
+    class Tagger(PersistentActor):
+        @property
+        def persistence_id(self):
+            return "tagger-1"
+
+        def receive_recover(self, message):
+            pass
+
+        def receive_command(self, message):
+            self.persist(Tagged.of(message, "blue"),
+                         lambda ev: self.sender.tell("ok", self.self_ref))
+
+    probe = TestProbe(system)
+    ref = system.actor_of(Props.create(Tagger))
+    ref.tell("e1", probe.ref)
+    probe.expect_msg("ok", 5.0)
+    ref.tell("e2", probe.ref)
+    probe.expect_msg("ok", 5.0)
+
+    rj = PersistenceQuery.get(system).read_journal_for()
+    envs = rj.current_events_by_tag("blue", NoOffset)
+    assert [e.event for e in envs] == ["e1", "e2"]
+    # replay of the actor sees UNtagged payloads
+    replayed = rj.current_events_by_persistence_id("tagger-1")
+    assert [e.event for e in replayed] == ["e1", "e2"]
+
+
+# -- TPU slab snapshots -------------------------------------------------------
+
+def test_slab_snapshot_roundtrip(tmp_path):
+    from akka_tpu.models.baseline_benches import build_ring, seed_ring_full
+
+    sys_ = build_ring(64)
+    seed_ring_full(sys_)
+    sys_.run(3)
+    sys_.block_until_ready()
+    path = slab_snapshot.save_slabs(sys_, str(tmp_path))
+
+    sys2 = build_ring(64)
+    slab_snapshot.restore_slabs(sys2, path)
+    import numpy as np
+    assert (np.asarray(sys2.read_state("received")) ==
+            np.asarray(sys_.read_state("received"))).all()
+    # restored system continues stepping identically
+    sys_.run(2); sys_.block_until_ready()
+    sys2.run(2); sys2.block_until_ready()
+    assert (np.asarray(sys2.read_state("received")) ==
+            np.asarray(sys_.read_state("received"))).all()
+    assert slab_snapshot.latest_slab_path(str(tmp_path)) == path
