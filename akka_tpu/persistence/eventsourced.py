@@ -267,6 +267,7 @@ class PersistentActor(Actor):
         self._extension.recovery_permitter.tell(ReturnRecoveryPermit(),
                                                 self.self_ref)
         self._call_recover(RecoveryCompleted())
+        self._flush_batch()  # RecoveryCompleted handler may have persisted
         self._unstash_internal()
 
     def _call_recover(self, msg: Any) -> None:

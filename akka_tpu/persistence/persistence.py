@@ -50,26 +50,31 @@ class RecoveryPermitter(Actor):
     def __init__(self, max_permits: int):
         super().__init__()
         self.max_permits = max_permits
-        self.used = 0
+        self.holders: set = set()   # refs that were actually GRANTED
         self.waiting: list = []
 
     def receive(self, message: Any) -> Any:
         if isinstance(message, RequestRecoveryPermit):
             self.context.watch(self.sender)
-            if self.used < self.max_permits:
-                self.used += 1
+            if len(self.holders) < self.max_permits:
+                self.holders.add(self.sender)
                 self.sender.tell(RecoveryPermitGranted(), self.self_ref)
             else:
                 self.waiting.append(self.sender)
         elif isinstance(message, ReturnRecoveryPermit):
-            self._return_permit(self.sender)
+            # a Return from an actor still queued (stopped while waiting)
+            # must NOT decrement — it never held a permit
+            if self.sender in self.holders:
+                self._return_permit(self.sender)
+            elif self.sender in self.waiting:
+                self.waiting.remove(self.sender)
+                self.context.unwatch(self.sender)
         else:
             from ..actor.messages import Terminated
             if isinstance(message, Terminated):
-                # died while recovering or waiting
                 if message.ref in self.waiting:
                     self.waiting.remove(message.ref)
-                else:
+                elif message.ref in self.holders:
                     self._return_permit(message.ref, watched_gone=True)
             else:
                 return NotImplemented
@@ -77,10 +82,10 @@ class RecoveryPermitter(Actor):
     def _return_permit(self, ref: ActorRef, watched_gone: bool = False) -> None:
         if not watched_gone:
             self.context.unwatch(ref)
-        self.used = max(0, self.used - 1)
-        if self.waiting and self.used < self.max_permits:
+        self.holders.discard(ref)
+        while self.waiting and len(self.holders) < self.max_permits:
             nxt = self.waiting.pop(0)
-            self.used += 1
+            self.holders.add(nxt)
             nxt.tell(RecoveryPermitGranted(), self.self_ref)
 
 

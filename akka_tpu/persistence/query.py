@@ -157,16 +157,24 @@ class ReadJournal:
         with lock:
             for env in current:
                 emitted.add(env.sequence_nr)
-            pending = sorted((r for r in buffered
-                              if r.sequence_nr not in emitted),
-                             key=lambda r: r.sequence_nr)
-            for r in pending:
-                emitted.add(r.sequence_nr)
-            live[0] = True
         for env in current:
             stream._push(env)
-        for r in pending:
-            stream._push(self._envelope(r))
+        # flush whatever arrived during the current read, in order, until a
+        # pass finds nothing new — ONLY then go live, so a concurrent write
+        # can never be pushed ahead of earlier events
+        while True:
+            with lock:
+                pending = sorted((r for r in buffered
+                                  if r.sequence_nr not in emitted),
+                                 key=lambda r: r.sequence_nr)
+                for r in pending:
+                    emitted.add(r.sequence_nr)
+                if not pending:
+                    live[0] = True
+                    buffered.clear()
+                    break
+            for r in pending:
+                stream._push(self._envelope(r))
         return stream
 
     def events_by_tag(self, tag: str, offset: Sequence = NoOffset
@@ -190,18 +198,23 @@ class ReadJournal:
         def listener(_r: PersistentRepr) -> None:
             with lock:
                 if not live[0]:
-                    return  # the initial read below will cover it
+                    return  # the initial read covers it (offset-tracked)
                 out = new_envelopes()
             for env in out:
                 stream._push(env)
 
         stream = EventStream(lambda: self.plugin.remove_listener(listener))
         self.plugin.add_listener(listener)
-        with lock:
-            initial = new_envelopes()
-            live[0] = True
-        for env in initial:
-            stream._push(env)
+        # loop until a read finds nothing new, then flip live under the same
+        # lock the listener takes — no window for out-of-order emission
+        while True:
+            with lock:
+                batch = new_envelopes()
+                if not batch:
+                    live[0] = True
+                    break
+            for env in batch:
+                stream._push(env)
         return stream
 
     @staticmethod

@@ -15,7 +15,7 @@ import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..actor.actor import Actor
-from .messages import (DeleteSnapshot, DeleteSnapshots,
+from .messages import (DeleteSnapshot, DeleteSnapshotFailure, DeleteSnapshots,
                        DeleteSnapshotsFailure, DeleteSnapshotsSuccess,
                        DeleteSnapshotSuccess, LoadSnapshot, LoadSnapshotFailed,
                        LoadSnapshotResult, SaveSnapshot, SaveSnapshotFailure,
@@ -178,9 +178,13 @@ class SnapshotStoreActor(Actor):
                 self.sender.tell(SaveSnapshotFailure(message.metadata, str(e)),
                                  self.self_ref)
         elif isinstance(message, DeleteSnapshot):
-            self.plugin.delete(message.metadata)
-            self.sender.tell(DeleteSnapshotSuccess(message.metadata),
-                             self.self_ref)
+            try:
+                self.plugin.delete(message.metadata)
+                self.sender.tell(DeleteSnapshotSuccess(message.metadata),
+                                 self.self_ref)
+            except Exception as e:  # noqa: BLE001
+                self.sender.tell(DeleteSnapshotFailure(message.metadata,
+                                                       str(e)), self.self_ref)
         elif isinstance(message, DeleteSnapshots):
             try:
                 self.plugin.delete_matching(message.persistence_id,

@@ -1,0 +1,37 @@
+"""Streams: backpressured processing pipelines (SURVEY.md §2.9).
+
+Host path: the push/pull GraphInterpreter port-state machine hosted in one
+actor per materialized graph (reference: impl/fusing/GraphInterpreter.scala
+semantics), with the Source/Flow/Sink DSL and the core operator library.
+TPU path: device pipelines that fuse a chain of tensor ops into a single
+jitted step over chunked arrays (akka_tpu/stream/device.py) — the XLA-fusion
+analogue of operator fusion in the reference materializer.
+"""
+
+from .stage import (FanInShape, FanOutShape, FlowShape, GraphStage,  # noqa: F401
+                    GraphStageLogic, InHandler, Inlet, OutHandler, Outlet,
+                    Shape, SinkShape, SourceShape, make_in_handler,
+                    make_out_handler)
+from .interpreter import (ActorGraphInterpreter, Connection,  # noqa: F401
+                          GraphInterpreter, IllegalStateException)
+from .dsl import Flow, Keep, Materializer, RunnableGraph, Sink, Source  # noqa: F401
+from .ops import (BufferOverflowException, NoSuchElementException,  # noqa: F401
+                  SinkQueue, SourceQueue, TickCancellable)
+from .killswitch import (KillSwitches, SharedKillSwitch,  # noqa: F401
+                         UniqueKillSwitch)
+from .hub import BroadcastHub, MergeHub  # noqa: F401
+from .device import DevicePipeline  # noqa: F401
+from .ops import _QUEUE_END as QUEUE_END  # noqa: F401
+
+__all__ = [
+    "Source", "Flow", "Sink", "Keep", "RunnableGraph", "Materializer",
+    "GraphStage", "GraphStageLogic", "InHandler", "OutHandler",
+    "Inlet", "Outlet", "Shape", "SourceShape", "SinkShape", "FlowShape",
+    "FanInShape", "FanOutShape", "make_in_handler", "make_out_handler",
+    "GraphInterpreter", "ActorGraphInterpreter", "Connection",
+    "IllegalStateException",
+    "SourceQueue", "SinkQueue", "QUEUE_END", "TickCancellable",
+    "NoSuchElementException", "BufferOverflowException",
+    "KillSwitches", "UniqueKillSwitch", "SharedKillSwitch",
+    "MergeHub", "BroadcastHub", "DevicePipeline",
+]

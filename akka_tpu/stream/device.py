@@ -1,0 +1,138 @@
+"""Device pipelines: tensor-shaped streams fused into single XLA programs.
+
+SURVEY.md §7 step 10: "on-device fused pipelines for tensor-shaped streams".
+Where the reference fuses operator islands into one actor (impl/
+PhasedFusingActorMaterializer.scala), the TPU-native analogue fuses a chain
+of per-chunk tensor ops into ONE jitted function — XLA then fuses the
+elementwise chain into a single kernel, so a 10-op pipeline costs one HBM
+round trip instead of ten. Chunks ride `lax.scan` when stacked on device
+(zero host round trips between chunks) or a host loop when streamed in.
+
+Filter semantics are mask-based: tensor streams keep static shapes (no
+data-dependent shapes under jit — SURVEY.md XLA semantics), so `filter`
+zeroes failing lanes and threads a validity mask; `compact()` at the end
+drops invalid lanes on the host.
+
+Integration: `.as_flow()` turns the compiled pipeline into a host-stream
+Flow operator so device pipelines compose with the backpressured DSL.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DevicePipeline:
+    """Chain of per-chunk tensor ops compiled to one jitted step.
+
+    ops:
+    - map(fn):        chunk -> chunk (elementwise or any shape-preserving op)
+    - filter(pred):   pred(chunk) -> bool mask over leading axis; failing
+                      lanes are zeroed and masked out
+    - scan(fn, init): stateful across chunks: fn(carry, chunk) -> (carry, out)
+    """
+
+    def __init__(self):
+        self._ops: List[Tuple] = []
+        self._scan_init = None
+        self._has_scan = False
+        self._compiled = None
+
+    # -- builders (return self for chaining) ---------------------------------
+    def map(self, fn: Callable) -> "DevicePipeline":
+        self._ops.append(("map", fn))
+        self._compiled = None
+        return self
+
+    def filter(self, pred: Callable) -> "DevicePipeline":
+        self._ops.append(("filter", pred))
+        self._compiled = None
+        return self
+
+    def scan(self, fn: Callable, init: Any) -> "DevicePipeline":
+        if self._has_scan:
+            raise ValueError("one scan per pipeline")
+        self._ops.append(("scan", fn))
+        self._scan_init = init
+        self._has_scan = True
+        self._compiled = None
+        return self
+
+    # -- compile --------------------------------------------------------------
+    def _build_step(self):
+        ops = list(self._ops)
+
+        def step(carry, chunk):
+            mask = jnp.ones((chunk.shape[0],), dtype=jnp.bool_)
+            x = chunk
+            for kind, fn in ops:
+                if kind == "map":
+                    x = fn(x)
+                elif kind == "filter":
+                    keep = fn(x)
+                    mask = jnp.logical_and(mask, keep)
+                    # zero failing lanes so later ops see neutral values
+                    zero_shape = (x.shape[0],) + (1,) * (x.ndim - 1)
+                    x = jnp.where(keep.reshape(zero_shape), x,
+                                  jnp.zeros_like(x))
+                else:  # scan
+                    carry, x = fn(carry, x)
+            return carry, (x, mask)
+        return step
+
+    def compile(self):
+        """One fused jitted step(carry, chunk) -> (carry, (out, mask))."""
+        if self._compiled is None:
+            self._compiled = jax.jit(self._build_step())
+        return self._compiled
+
+    # -- run ------------------------------------------------------------------
+    def run(self, chunks) -> Tuple[Any, Any, Any]:
+        """Run over chunks. If `chunks` is a stacked array [n_chunks, ...],
+        the whole pipeline is ONE lax.scan on device; otherwise a host loop
+        feeds the jitted step chunk by chunk.
+
+        Returns (outputs, masks, final_carry) with outputs/masks stacked.
+        """
+        step = self.compile()
+        carry0 = self._scan_init if self._scan_init is not None else 0
+        if isinstance(chunks, (jnp.ndarray, np.ndarray)) and \
+                getattr(chunks, "ndim", 0) >= 2:
+            final_carry, (outs, masks) = jax.lax.scan(
+                step, carry0, jnp.asarray(chunks))
+            return outs, masks, final_carry
+        outs, masks = [], []
+        carry = carry0
+        for chunk in chunks:
+            carry, (out, mask) = step(carry, jnp.asarray(chunk))
+            outs.append(out)
+            masks.append(mask)
+        return jnp.stack(outs), jnp.stack(masks), carry
+
+    @staticmethod
+    def compact(outs, masks) -> np.ndarray:
+        """Host-side: drop masked-out lanes and flatten chunk structure."""
+        o = np.asarray(outs)
+        m = np.asarray(masks).astype(bool)
+        flat_o = o.reshape((-1,) + o.shape[2:])
+        return flat_o[m.reshape(-1)]
+
+    # -- host-stream integration ---------------------------------------------
+    def as_flow(self):
+        """A Flow operator running this pipeline per stream element (each
+        element is one chunk); emits (out_chunk, mask) pairs. The carry is
+        threaded across elements — a stateful fused stage."""
+        from .dsl import Flow
+        step = self.compile()
+        state = {"carry": self._scan_init if self._scan_init is not None
+                 else 0}
+
+        def apply(chunk):
+            state["carry"], (out, mask) = step(state["carry"],
+                                               jnp.asarray(chunk))
+            return out, mask
+        return Flow().map(apply)

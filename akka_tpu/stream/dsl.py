@@ -1,0 +1,555 @@
+"""Source/Flow/Sink DSL + materializer.
+
+Reference parity: akka-stream/src/main/scala/akka/stream/scaladsl/
+(Source.scala, Flow.scala, Sink.scala, Keep.scala, RunnableGraph in
+Flow.scala) and impl/PhasedFusingActorMaterializer.scala — here every
+materialization fuses the whole graph into ONE island hosted by one
+ActorGraphInterpreter actor (the reference's default is maximal fusion too;
+async islands come from mapAsync/hubs, which in this design use async
+callbacks into the same interpreter instead of actor-to-actor batches).
+
+Blueprints are REUSABLE: each Source/Flow/Sink holds a build function that
+instantiates fresh stages per run (the reference's traversal re-walk).
+Materialized values compose with Keep.left/right/both/none.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..actor.props import Props
+from .interpreter import ActorGraphInterpreter, Connection, GraphInterpreter
+from .stage import (FlowShape, GraphStage, GraphStageLogic, Inlet, Outlet,
+                    SinkShape, SourceShape, make_in_handler, make_out_handler)
+from . import ops as _ops
+
+
+class Keep:
+    left = staticmethod(lambda l, r: l)
+    right = staticmethod(lambda l, r: r)
+    both = staticmethod(lambda l, r: (l, r))
+    none = staticmethod(lambda l, r: None)
+
+
+class _Builder:
+    """Collects stage logics + edges during one materialization."""
+
+    def __init__(self, materializer: "Materializer"):
+        self.materializer = materializer
+        self.logics: List[GraphStageLogic] = []
+        self.logic_by_port: Dict[int, GraphStageLogic] = {}
+        self.edges: List[Tuple[Outlet, Inlet]] = []
+
+    def add(self, stage: GraphStage) -> Tuple[GraphStageLogic, Any]:
+        logic, mat = stage.create_logic_and_mat()
+        self.logics.append(logic)
+        for p in logic.shape.inlets:
+            self.logic_by_port[p.id] = logic
+        for p in logic.shape.outlets:
+            self.logic_by_port[p.id] = logic
+        return logic, mat
+
+    def connect(self, outlet: Outlet, inlet: Inlet) -> None:
+        self.edges.append((outlet, inlet))
+
+
+class Materializer:
+    """(reference: stream/Materializer.scala / SystemMaterializer.scala)"""
+
+    _counter = itertools.count()
+
+    def __init__(self, system):
+        self.system = system
+
+    def materialize(self, build: Callable[[_Builder], Any]) -> Any:
+        b = _Builder(self)
+        mat = build(b)
+        connections = []
+        for i, (outlet, inlet) in enumerate(b.edges):
+            connections.append(Connection(
+                i, b.logic_by_port[outlet.id], outlet,
+                b.logic_by_port[inlet.id], inlet))
+        interp = GraphInterpreter(b.logics, connections, materializer=self)
+        self.system.actor_of(
+            Props.create(ActorGraphInterpreter, interp),
+            f"stream-{next(Materializer._counter)}")
+        return mat
+
+
+# -- Source -------------------------------------------------------------------
+
+class Source:
+    """build(b) -> (open outlet, mat value)."""
+
+    def __init__(self, build: Callable[[_Builder], Tuple[Outlet, Any]]):
+        self._build = build
+
+    # -- constructors ---------------------------------------------------------
+    @staticmethod
+    def from_graph(stage_factory: Callable[[], GraphStage]) -> "Source":
+        def build(b: _Builder):
+            logic, mat = b.add(stage_factory())
+            return logic.shape.outlets[0], mat
+        return Source(build)
+
+    @staticmethod
+    def from_iterable(it) -> "Source":
+        return Source.from_graph(lambda: _ops.IterableSource(it))
+
+    @staticmethod
+    def apply(it) -> "Source":
+        return Source.from_iterable(it)
+
+    @staticmethod
+    def single(elem) -> "Source":
+        return Source.from_iterable([elem])
+
+    @staticmethod
+    def empty() -> "Source":
+        return Source.from_iterable([])
+
+    @staticmethod
+    def failed(ex: BaseException) -> "Source":
+        return Source.from_graph(lambda: _ops.FailedSource(ex))
+
+    @staticmethod
+    def repeat(elem) -> "Source":
+        return Source.from_graph(lambda: _ops.RepeatSource(elem))
+
+    @staticmethod
+    def cycle(factory: Callable[[], Any]) -> "Source":
+        return Source.from_graph(lambda: _ops.CycleSource(factory))
+
+    @staticmethod
+    def unfold(zero, fn: Callable[[Any], Optional[Tuple[Any, Any]]]) -> "Source":
+        return Source.from_graph(lambda: _ops.UnfoldSource(zero, fn))
+
+    @staticmethod
+    def tick(initial_delay: float, interval: float, tick: Any) -> "Source":
+        return Source.from_graph(lambda: _ops.TickSource(
+            initial_delay, interval, tick))
+
+    @staticmethod
+    def queue(buffer_size: int = 256) -> "Source":
+        """Materializes a SourceQueue with offer/complete/fail."""
+        return Source.from_graph(lambda: _ops.QueueSource(buffer_size))
+
+    @staticmethod
+    def from_future(fut: Future) -> "Source":
+        return Source.from_graph(lambda: _ops.FutureSource(fut))
+
+    @staticmethod
+    def actor_ref(buffer_size: int = 256) -> "Source":
+        """Materializes an ActorRef; messages sent to it are emitted
+        (reference: Source.actorRef; complete with Status.Success)."""
+        return Source.from_graph(lambda: _ops.ActorRefSource(buffer_size))
+
+    @staticmethod
+    def combine(first: "Source", second: "Source", *rest: "Source") -> "Source":
+        return first.merge(second) if not rest else \
+            Source.combine(first.merge(second), *rest)
+
+    # -- composition ----------------------------------------------------------
+    def via(self, flow: "Flow", combine=Keep.left) -> "Source":
+        src_build, flow_build = self._build, flow._build
+
+        def build(b: _Builder):
+            outlet, m1 = src_build(b)
+            outlet2, m2 = flow_build(b, outlet)
+            return outlet2, combine(m1, m2)
+        return Source(build)
+
+    def via_mat(self, flow: "Flow", combine) -> "Source":
+        return self.via(flow, combine)
+
+    def to(self, sink: "Sink", combine=Keep.left) -> "RunnableGraph":
+        src_build, sink_build = self._build, sink._build
+
+        def build(b: _Builder):
+            outlet, m1 = src_build(b)
+            m2 = sink_build(b, outlet)
+            return combine(m1, m2)
+        return RunnableGraph(build)
+
+    def to_mat(self, sink: "Sink", combine) -> "RunnableGraph":
+        return self.to(sink, combine)
+
+    def run_with(self, sink: "Sink", materializer_or_system) -> Any:
+        return self.to(sink, Keep.right).run(materializer_or_system)
+
+    # -- fan-in convenience ---------------------------------------------------
+    def merge(self, other: "Source") -> "Source":
+        b1, b2 = self._build, other._build
+
+        def build(b: _Builder):
+            o1, m1 = b1(b)
+            o2, _m2 = b2(b)
+            logic, _ = b.add(_ops.MergeStage(2))
+            b.connect(o1, logic.shape.ins[0])
+            b.connect(o2, logic.shape.ins[1])
+            return logic.shape.out, m1
+        return Source(build)
+
+    def concat(self, other: "Source") -> "Source":
+        b1, b2 = self._build, other._build
+
+        def build(b: _Builder):
+            o1, m1 = b1(b)
+            o2, _m2 = b2(b)
+            logic, _ = b.add(_ops.ConcatStage(2))
+            b.connect(o1, logic.shape.ins[0])
+            b.connect(o2, logic.shape.ins[1])
+            return logic.shape.out, m1
+        return Source(build)
+
+    def prepend(self, other: "Source") -> "Source":
+        return other.concat(self)
+
+    def or_else(self, other: "Source") -> "Source":
+        b1, b2 = self._build, other._build
+
+        def build(b: _Builder):
+            o1, m1 = b1(b)
+            o2, _m2 = b2(b)
+            logic, _ = b.add(_ops.OrElseStage())
+            b.connect(o1, logic.shape.ins[0])
+            b.connect(o2, logic.shape.ins[1])
+            return logic.shape.out, m1
+        return Source(build)
+
+    def zip(self, other: "Source") -> "Source":
+        return self.zip_with(other, lambda a, b: (a, b))
+
+    def zip_with(self, other: "Source", fn) -> "Source":
+        b1, b2 = self._build, other._build
+
+        def build(b: _Builder):
+            o1, m1 = b1(b)
+            o2, _m2 = b2(b)
+            logic, _ = b.add(_ops.ZipWithStage(fn))
+            b.connect(o1, logic.shape.ins[0])
+            b.connect(o2, logic.shape.ins[1])
+            return logic.shape.out, m1
+        return Source(build)
+
+    def interleave(self, other: "Source", segment_size: int) -> "Source":
+        b1, b2 = self._build, other._build
+
+        def build(b: _Builder):
+            o1, m1 = b1(b)
+            o2, _m2 = b2(b)
+            logic, _ = b.add(_ops.InterleaveStage(segment_size))
+            b.connect(o1, logic.shape.ins[0])
+            b.connect(o2, logic.shape.ins[1])
+            return logic.shape.out, m1
+        return Source(build)
+
+    def also_to(self, sink: "Sink") -> "Source":
+        src_build, sink_build = self._build, sink._build
+
+        def build(b: _Builder):
+            o1, m1 = src_build(b)
+            logic, _ = b.add(_ops.BroadcastStage(2, eager_cancel=False))
+            b.connect(o1, logic.shape.in_)
+            sink_build(b, logic.shape.outs[1])
+            return logic.shape.outs[0], m1
+        return Source(build)
+
+    def wire_tap(self, fn: Callable[[Any], None]) -> "Source":
+        return self.via(Flow().wire_tap(fn))
+
+    # -- run ------------------------------------------------------------------
+    def run(self, materializer_or_system) -> Any:
+        return self.to(Sink.ignore(), Keep.left).run(materializer_or_system)
+
+    def run_fold(self, zero, fn, materializer_or_system) -> Future:
+        return self.run_with(Sink.fold(zero, fn), materializer_or_system)
+
+    def run_foreach(self, fn, materializer_or_system) -> Future:
+        return self.run_with(Sink.foreach(fn), materializer_or_system)
+
+    def run_reduce(self, fn, materializer_or_system) -> Future:
+        return self.run_with(Sink.reduce(fn), materializer_or_system)
+
+
+def _linear(op_factory: Callable[[], GraphStage]):
+    """Helper: append one 1-in/1-out stage to a Flow/Source chain."""
+    def flow_build(b: _Builder, upstream: Outlet):
+        logic, mat = b.add(op_factory())
+        b.connect(upstream, logic.shape.in_)
+        return logic.shape.out, mat
+    return flow_build
+
+
+class Flow:
+    """build(b, upstream_outlet) -> (outlet, mat)."""
+
+    def __init__(self, build: Optional[Callable] = None):
+        if build is None:
+            def build(b: _Builder, upstream: Outlet):
+                return upstream, None
+        self._build = build
+
+    @staticmethod
+    def from_graph(stage_factory: Callable[[], GraphStage]) -> "Flow":
+        def build(b: _Builder, upstream: Outlet):
+            logic, mat = b.add(stage_factory())
+            b.connect(upstream, logic.shape.inlets[0])
+            return logic.shape.outlets[0], mat
+        return Flow(build)
+
+    @staticmethod
+    def from_function(fn: Callable[[Any], Any]) -> "Flow":
+        return Flow().map(fn)
+
+    def _append(self, op_factory: Callable[[], GraphStage],
+                combine=Keep.left) -> "Flow":
+        prev = self._build
+        nxt = _linear(op_factory)
+
+        def build(b: _Builder, upstream: Outlet):
+            o1, m1 = prev(b, upstream)
+            o2, m2 = nxt(b, o1)
+            return o2, combine(m1, m2)
+        return Flow(build)
+
+    def via(self, other: "Flow", combine=Keep.left) -> "Flow":
+        prev, nxt = self._build, other._build
+
+        def build(b: _Builder, upstream: Outlet):
+            o1, m1 = prev(b, upstream)
+            o2, m2 = nxt(b, o1)
+            return o2, combine(m1, m2)
+        return Flow(build)
+
+    via_mat = via
+
+    def to(self, sink: "Sink", combine=Keep.left) -> "Sink":
+        prev, sink_build = self._build, sink._build
+
+        def build(b: _Builder, upstream: Outlet):
+            o1, m1 = prev(b, upstream)
+            m2 = sink_build(b, o1)
+            return combine(m1, m2)
+        return Sink(build)
+
+    to_mat = to
+
+    # -- operator library (reference: scaladsl/Flow.scala ~200 defs;
+    #    the stages live in akka_tpu/stream/ops.py) --------------------------
+    def map(self, fn) -> "Flow":
+        return self._append(lambda: _ops.Map(fn))
+
+    def map_concat(self, fn) -> "Flow":
+        return self._append(lambda: _ops.MapConcat(fn))
+
+    def stateful_map_concat(self, factory) -> "Flow":
+        return self._append(lambda: _ops.StatefulMapConcat(factory))
+
+    def filter(self, pred) -> "Flow":
+        return self._append(lambda: _ops.Filter(pred))
+
+    def filter_not(self, pred) -> "Flow":
+        return self._append(lambda: _ops.Filter(lambda x: not pred(x)))
+
+    def collect(self, fn) -> "Flow":
+        """fn returns None to drop (partial-function analogue)."""
+        return self._append(lambda: _ops.Collect(fn))
+
+    def take(self, n: int) -> "Flow":
+        return self._append(lambda: _ops.Take(n))
+
+    def take_while(self, pred, inclusive: bool = False) -> "Flow":
+        return self._append(lambda: _ops.TakeWhile(pred, inclusive))
+
+    def drop(self, n: int) -> "Flow":
+        return self._append(lambda: _ops.Drop(n))
+
+    def drop_while(self, pred) -> "Flow":
+        return self._append(lambda: _ops.DropWhile(pred))
+
+    def scan(self, zero, fn) -> "Flow":
+        return self._append(lambda: _ops.Scan(zero, fn))
+
+    def fold(self, zero, fn) -> "Flow":
+        return self._append(lambda: _ops.Fold(zero, fn))
+
+    def reduce(self, fn) -> "Flow":
+        return self._append(lambda: _ops.Reduce(fn))
+
+    def grouped(self, n: int) -> "Flow":
+        return self._append(lambda: _ops.Grouped(n))
+
+    def sliding(self, n: int, step: int = 1) -> "Flow":
+        return self._append(lambda: _ops.Sliding(n, step))
+
+    def intersperse(self, sep, start=None, end=None) -> "Flow":
+        return self._append(lambda: _ops.Intersperse(sep, start, end))
+
+    def zip_with_index(self) -> "Flow":
+        return self.stateful_map_concat(
+            lambda: (lambda counter=itertools.count():
+                     (lambda x: [(x, next(counter))]))())
+
+    def buffer(self, size: int, overflow_strategy: str = "backpressure"
+               ) -> "Flow":
+        return self._append(lambda: _ops.Buffer(size, overflow_strategy))
+
+    def conflate(self, aggregate) -> "Flow":
+        return self.conflate_with_seed(lambda x: x, aggregate)
+
+    def conflate_with_seed(self, seed, aggregate) -> "Flow":
+        return self._append(lambda: _ops.Conflate(seed, aggregate))
+
+    def batch(self, max_n: int, seed, aggregate) -> "Flow":
+        return self._append(lambda: _ops.Batch(max_n, seed, aggregate))
+
+    def expand(self, extrapolate) -> "Flow":
+        return self._append(lambda: _ops.Expand(extrapolate))
+
+    def map_async(self, parallelism: int, fn) -> "Flow":
+        return self._append(lambda: _ops.MapAsync(parallelism, fn,
+                                                  ordered=True))
+
+    def map_async_unordered(self, parallelism: int, fn) -> "Flow":
+        return self._append(lambda: _ops.MapAsync(parallelism, fn,
+                                                  ordered=False))
+
+    def throttle(self, elements: int, per: float,
+                 maximum_burst: Optional[int] = None) -> "Flow":
+        return self._append(lambda: _ops.Throttle(
+            elements, per, maximum_burst or elements))
+
+    def delay(self, of: float) -> "Flow":
+        return self._append(lambda: _ops.Delay(of))
+
+    def recover(self, fn) -> "Flow":
+        """fn(exc) -> final element (or raise to propagate)."""
+        return self._append(lambda: _ops.Recover(fn))
+
+    def log(self, name: str, extract=lambda x: x) -> "Flow":
+        return self._append(lambda: _ops.Log(name, extract))
+
+    def wire_tap(self, fn) -> "Flow":
+        return self._append(lambda: _ops.WireTap(fn))
+
+    def also_to(self, sink: "Sink") -> "Flow":
+        prev, sink_build = self._build, sink._build
+
+        def build(b: _Builder, upstream: Outlet):
+            o1, m1 = prev(b, upstream)
+            logic, _ = b.add(_ops.BroadcastStage(2, eager_cancel=False))
+            b.connect(o1, logic.shape.in_)
+            sink_build(b, logic.shape.outs[1])
+            return logic.shape.outs[0], m1
+        return Flow(build)
+
+    def flat_map_concat(self, fn: Callable[[Any], "Source"]) -> "Flow":
+        return self._append(lambda: _ops.FlatMapConcat(fn))
+
+    def merge(self, other: Source) -> "Flow":
+        prev, other_build = self._build, other._build
+
+        def build(b: _Builder, upstream: Outlet):
+            o1, m1 = prev(b, upstream)
+            o2, _ = other_build(b)
+            logic, _l = b.add(_ops.MergeStage(2))
+            b.connect(o1, logic.shape.ins[0])
+            b.connect(o2, logic.shape.ins[1])
+            return logic.shape.out, m1
+        return Flow(build)
+
+    def zip(self, other: Source) -> "Flow":
+        prev, other_build = self._build, other._build
+
+        def build(b: _Builder, upstream: Outlet):
+            o1, m1 = prev(b, upstream)
+            o2, _ = other_build(b)
+            logic, _l = b.add(_ops.ZipWithStage(lambda a, bb: (a, bb)))
+            b.connect(o1, logic.shape.ins[0])
+            b.connect(o2, logic.shape.ins[1])
+            return logic.shape.out, m1
+        return Flow(build)
+
+
+class Sink:
+    """build(b, upstream_outlet) -> mat."""
+
+    def __init__(self, build: Callable[[_Builder, Outlet], Any]):
+        self._build = build
+
+    @staticmethod
+    def from_graph(stage_factory: Callable[[], GraphStage]) -> "Sink":
+        def build(b: _Builder, upstream: Outlet):
+            logic, mat = b.add(stage_factory())
+            b.connect(upstream, logic.shape.inlets[0])
+            return mat
+        return Sink(build)
+
+    @staticmethod
+    def ignore() -> "Sink":
+        return Sink.from_graph(lambda: _ops.IgnoreSink())
+
+    @staticmethod
+    def foreach(fn) -> "Sink":
+        return Sink.from_graph(lambda: _ops.ForeachSink(fn))
+
+    @staticmethod
+    def seq() -> "Sink":
+        return Sink.from_graph(lambda: _ops.SeqSink())
+
+    @staticmethod
+    def fold(zero, fn) -> "Sink":
+        return Sink.from_graph(lambda: _ops.FoldSink(zero, fn))
+
+    @staticmethod
+    def reduce(fn) -> "Sink":
+        return Sink.from_graph(lambda: _ops.ReduceSink(fn))
+
+    @staticmethod
+    def head() -> "Sink":
+        return Sink.from_graph(lambda: _ops.HeadSink(require=True))
+
+    @staticmethod
+    def head_option() -> "Sink":
+        return Sink.from_graph(lambda: _ops.HeadSink(require=False))
+
+    @staticmethod
+    def last() -> "Sink":
+        return Sink.from_graph(lambda: _ops.LastSink(require=True))
+
+    @staticmethod
+    def last_option() -> "Sink":
+        return Sink.from_graph(lambda: _ops.LastSink(require=False))
+
+    @staticmethod
+    def on_complete(fn: Callable[[Optional[BaseException]], None]) -> "Sink":
+        return Sink.from_graph(lambda: _ops.OnCompleteSink(fn))
+
+    @staticmethod
+    def queue(buffer_size: int = 256) -> "Sink":
+        return Sink.from_graph(lambda: _ops.QueueSink(buffer_size))
+
+    @staticmethod
+    def actor_ref(ref, on_complete_message: Any,
+                  on_failure_message: Callable[[BaseException], Any] = None
+                  ) -> "Sink":
+        return Sink.from_graph(lambda: _ops.ActorRefSink(
+            ref, on_complete_message, on_failure_message))
+
+    def contramap(self, fn) -> "Sink":
+        return Flow().map(fn).to(self, Keep.right)
+
+
+class RunnableGraph:
+    def __init__(self, build: Callable[[_Builder], Any]):
+        self._build = build
+
+    def run(self, materializer_or_system) -> Any:
+        mat = materializer_or_system
+        if not isinstance(mat, Materializer):
+            mat = Materializer(getattr(mat, "classic", mat))
+        return mat.materialize(self._build)

@@ -1,0 +1,316 @@
+"""Hubs: dynamic fan-in/fan-out across independent materializations.
+
+Reference parity: akka-stream/src/main/scala/akka/stream/scaladsl/Hub.scala —
+MergeHub.source materializes a Sink that MANY producer streams can attach to
+at runtime; BroadcastHub.sink materializes a Source that MANY consumer
+streams can attach to (slowest-consumer backpressure over a bounded buffer).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Dict, List, Optional
+
+from .stage import (GraphStage, GraphStageLogic, Inlet, Outlet, SinkShape,
+                    SourceShape, make_in_handler, make_out_handler)
+
+
+# ============================== MergeHub ====================================
+
+class _MergeHubState:
+    """Shared between the hub source stage and attached producer sinks."""
+
+    def __init__(self, per_producer_buffer: int):
+        self.lock = threading.Lock()
+        self.buffer_size = per_producer_buffer
+        self.buf: collections.deque = collections.deque()
+        self.waiting_producers: collections.deque = collections.deque()
+        self.consumer_cb = None      # async callback into the hub source
+        self.closed = False
+
+
+class _MergeHubSource(GraphStage):
+    def __init__(self, state: _MergeHubState):
+        self.name = "MergeHubSource"
+        self.state = state
+        self.out = Outlet("MergeHub.out")
+        self._shape = SourceShape(self.out)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    def create_logic(self):
+        st, out = self.state, self.out
+
+        class _L(GraphStageLogic):
+            def pre_start(self):
+                with st.lock:
+                    st.consumer_cb = self.get_async_callback(self._wakeup)
+
+            def _wakeup(self, _):
+                self._try_emit()
+
+            def _try_emit(self):
+                while self.is_available(out):
+                    with st.lock:
+                        if not st.buf:
+                            return
+                        elem = st.buf.popleft()
+                        resume = None
+                        if st.waiting_producers:
+                            resume = st.waiting_producers.popleft()
+                    self.push(out, elem)
+                    if resume is not None:
+                        resume.invoke(None)
+
+            def post_stop(self):
+                with st.lock:
+                    st.closed = True
+                    waiting = list(st.waiting_producers)
+                    st.waiting_producers.clear()
+                for w in waiting:
+                    w.invoke(None)
+        logic = _L(self._shape)
+        logic.set_handler(out, make_out_handler(
+            lambda: logic._try_emit(),
+            lambda cause=None: logic.post_stop() or logic.cancel_stage(cause)))
+        return logic
+
+
+class _MergeHubSink(GraphStage):
+    def __init__(self, state: _MergeHubState):
+        self.name = "MergeHubSink"
+        self.state = state
+        self.in_ = Inlet("MergeHub.in")
+        self._shape = SinkShape(self.in_)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    def create_logic(self):
+        st, in_ = self.state, self.in_
+
+        class _L(GraphStageLogic):
+            def pre_start(self):
+                self._resume_cb = self.get_async_callback(
+                    lambda _: self._resume())
+                self.pull(in_)
+
+            def _resume(self):
+                with st.lock:
+                    closed = st.closed
+                if closed:
+                    self.complete_stage()
+                elif not self.has_been_pulled(in_) and \
+                        not self.is_closed(in_):
+                    self.pull(in_)
+        logic = _L(self._shape)
+
+        def on_push():
+            elem = logic.grab(in_)
+            wake = None
+            with st.lock:
+                if st.closed:
+                    pass  # consumer gone: drop + complete below
+                else:
+                    st.buf.append(elem)
+                    wake = st.consumer_cb
+                    if len(st.buf) >= st.buffer_size:
+                        st.waiting_producers.append(logic._resume_cb)
+                        if wake is not None:
+                            wake.invoke(None)
+                        return  # backpressure this producer
+            if st.closed:
+                logic.complete_stage()
+                return
+            if wake is not None:
+                wake.invoke(None)
+            logic.pull(in_)
+        logic.set_handler(in_, make_in_handler(on_push))
+        return logic
+
+
+class MergeHub:
+    @staticmethod
+    def source(per_producer_buffer_size: int = 16):
+        """Source whose mat value is a reusable Sink producers attach to."""
+        from .dsl import Sink, Source
+
+        def build(b):
+            state = _MergeHubState(per_producer_buffer_size)
+            logic, _ = b.add(_MergeHubSource(state))
+            attach_sink = Sink.from_graph(lambda: _MergeHubSink(state))
+            return logic.shape.outlets[0], attach_sink
+        return Source(build)
+
+
+# ============================= BroadcastHub =================================
+
+class _BroadcastHubState:
+    def __init__(self, buffer_size: int):
+        self.lock = threading.Lock()
+        self.buffer_size = buffer_size
+        self.consumers: List["_ConsumerSlot"] = []
+        self.pending: collections.deque = collections.deque()  # pre-consumer
+        self.upstream_cb = None
+        self.done = None  # ("complete",) | ("fail", ex)
+
+
+class _ConsumerSlot:
+    def __init__(self, cb):
+        self.cb = cb  # async callback into the consumer source stage
+        self.buf: collections.deque = collections.deque()
+
+
+class _BroadcastHubSink(GraphStage):
+    def __init__(self, state: _BroadcastHubState):
+        self.name = "BroadcastHubSink"
+        self.state = state
+        self.in_ = Inlet("BcastHub.in")
+        self._shape = SinkShape(self.in_)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    def create_logic(self):
+        st, in_ = self.state, self.in_
+
+        class _L(GraphStageLogic):
+            def pre_start(self):
+                self.set_keep_going(True)
+                with st.lock:
+                    st.upstream_cb = self.get_async_callback(
+                        lambda _: self._maybe_pull())
+                self.pull(in_)
+
+            def _maybe_pull(self):
+                with st.lock:
+                    room = all(len(c.buf) < st.buffer_size
+                               for c in st.consumers) \
+                        and len(st.pending) < st.buffer_size
+                if room and not self.has_been_pulled(in_) and \
+                        not self.is_closed(in_):
+                    self.pull(in_)
+        logic = _L(self._shape)
+
+        def on_push():
+            elem = logic.grab(in_)
+            wakes = []
+            with st.lock:
+                if st.consumers:
+                    for c in st.consumers:
+                        c.buf.append(elem)
+                        wakes.append(c.cb)
+                    room = all(len(c.buf) < st.buffer_size
+                               for c in st.consumers)
+                else:
+                    st.pending.append(elem)
+                    room = len(st.pending) < st.buffer_size
+            for w in wakes:
+                w.invoke(None)
+            if room:
+                logic.pull(in_)
+            # else: slowest consumer backpressures; resumed via upstream_cb
+
+        def on_finish():
+            wakes = []
+            with st.lock:
+                st.done = ("complete",)
+                wakes = [c.cb for c in st.consumers]
+            for w in wakes:
+                w.invoke(None)
+            logic.set_keep_going(False)
+            logic.complete_stage()
+
+        def on_failure(ex):
+            wakes = []
+            with st.lock:
+                st.done = ("fail", ex)
+                wakes = [c.cb for c in st.consumers]
+            for w in wakes:
+                w.invoke(None)
+            logic.set_keep_going(False)
+            logic.fail_stage(ex)
+        logic.set_handler(in_, make_in_handler(on_push, on_finish, on_failure))
+        return logic
+
+
+class _BroadcastHubSource(GraphStage):
+    def __init__(self, state: _BroadcastHubState):
+        self.name = "BroadcastHubSource"
+        self.state = state
+        self.out = Outlet("BcastHub.out")
+        self._shape = SourceShape(self.out)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    def create_logic(self):
+        st, out = self.state, self.out
+        slot_holder: Dict[str, _ConsumerSlot] = {}
+
+        class _L(GraphStageLogic):
+            def pre_start(self):
+                slot = _ConsumerSlot(self.get_async_callback(
+                    lambda _: self._deliver()))
+                slot_holder["slot"] = slot
+                with st.lock:
+                    # late joiner takes over any pre-consumer backlog once
+                    if not st.consumers and st.pending:
+                        slot.buf.extend(st.pending)
+                        st.pending.clear()
+                    st.consumers.append(slot)
+
+            def _deliver(self):
+                slot = slot_holder["slot"]
+                pulled_upstream = None
+                while self.is_available(out):
+                    with st.lock:
+                        if not slot.buf:
+                            break
+                        elem = slot.buf.popleft()
+                        pulled_upstream = st.upstream_cb
+                    self.push(out, elem)
+                with st.lock:
+                    done = st.done if not slot.buf else None
+                if done is not None:
+                    if done[0] == "complete":
+                        self.complete(out)
+                    else:
+                        self.fail(out, done[1])
+                    return
+                if pulled_upstream is not None:
+                    pulled_upstream.invoke(None)
+
+            def post_stop(self):
+                with st.lock:
+                    slot = slot_holder.get("slot")
+                    if slot in st.consumers:
+                        st.consumers.remove(slot)
+                    cb = st.upstream_cb
+                if cb is not None:
+                    cb.invoke(None)  # fewer consumers: maybe unblock
+        logic = _L(self._shape)
+        logic.set_handler(out, make_out_handler(lambda: logic._deliver()))
+        return logic
+
+
+class BroadcastHub:
+    @staticmethod
+    def sink(buffer_size: int = 256):
+        """Sink whose mat value is a reusable Source consumers attach to."""
+        from .dsl import Sink, Source
+
+        def build(b, upstream):
+            state = _BroadcastHubState(buffer_size)
+            logic, _ = b.add(_BroadcastHubSink(state))
+            b.connect(upstream, logic.shape.inlets[0])
+            attach_source = Source.from_graph(
+                lambda: _BroadcastHubSource(state))
+            return attach_source
+        return Sink(build)
