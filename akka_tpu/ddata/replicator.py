@@ -581,7 +581,7 @@ class Replicator(Actor):
                 self.context.unwatch(message.subscriber)
         elif isinstance(message, ActorTerminated):
             for subs in self.subscribers.values():
-                subs.discard(message.ref)
+                subs.discard(message.actor)
         elif isinstance(message, GetKeyIds):
             ids = frozenset(k for k, v in self.data.items() if v != DELETED)
             self.sender.tell(GetKeyIdsResult(ids), self.self_ref)

@@ -72,10 +72,10 @@ class RecoveryPermitter(Actor):
         else:
             from ..actor.messages import Terminated
             if isinstance(message, Terminated):
-                if message.ref in self.waiting:
-                    self.waiting.remove(message.ref)
-                elif message.ref in self.holders:
-                    self._return_permit(message.ref, watched_gone=True)
+                if message.actor in self.waiting:
+                    self.waiting.remove(message.actor)
+                elif message.actor in self.holders:
+                    self._return_permit(message.actor, watched_gone=True)
             else:
                 return NotImplemented
 

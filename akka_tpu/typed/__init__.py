@@ -19,3 +19,8 @@ from .behaviors import (Behaviors, SupervisorStrategy, TimerScheduler,  # noqa: 
                         StashBuffer, StashException)
 from .adapter import TypedActorContext, props_from_behavior  # noqa: F401
 from .actor_system import ActorSystem  # noqa: F401
+from .receptionist import (Deregister, Deregistered, Find, Listing,  # noqa: F401
+                           Receptionist, Register, Registered, ServiceKey,
+                           Subscribe)
+from . import delivery  # noqa: F401
+from .pubsub import Publish, Topic, TopicSubscribe, TopicUnsubscribe  # noqa: F401

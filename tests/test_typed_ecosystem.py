@@ -1,0 +1,333 @@
+"""Receptionist, reliable delivery, typed pub-sub, stream-typed adapters —
+modeled on the reference specs (akka-actor-typed-tests: ReceptionistSpec,
+ReliableDeliverySpec, ReliableDeliveryWithWorkPullingSpec, TopicSpec;
+akka-stream-typed: ActorSourceSinkSpec) plus the cluster receptionist
+multi-jvm spec over the in-proc transport."""
+
+import time
+
+import pytest
+
+from akka_tpu import ActorSystem, Props
+from akka_tpu.actor.actor import Actor
+from akka_tpu.testkit import TestProbe, await_condition
+from akka_tpu.typed import (Find, Listing, Publish, Receptionist, Register,
+                            ServiceKey, Subscribe, Topic, TopicSubscribe)
+from akka_tpu.typed.delivery import (Ack, Confirmed, ConsumerController,
+                                     Delivery, MessageWithConfirmation,
+                                     ProducerController,
+                                     RegisterToProducerController,
+                                     RequestNext, Start,
+                                     WorkPullingRequestNext,
+                                     consumer_controller_props,
+                                     producer_controller_props,
+                                     work_pulling_producer_props)
+
+CFG = {"akka": {"stdout-loglevel": "OFF", "log-dead-letters": 0}}
+
+
+@pytest.fixture()
+def system():
+    s = ActorSystem.create("typed-eco", CFG)
+    yield s
+    s.terminate()
+    s.await_termination(10.0)
+
+
+class Echo(Actor):
+    def receive(self, message):
+        self.sender.tell(("echo", message), self.self_ref)
+
+
+# -- receptionist (local) -----------------------------------------------------
+
+def test_receptionist_register_find_subscribe(system):
+    rec = Receptionist.get(system)
+    key = ServiceKey("echo-service")
+    probe = TestProbe(system)
+    svc1 = system.actor_of(Props.create(Echo), "svc1")
+
+    rec.register(key, svc1, reply_to=probe.ref)
+    registered = probe.receive_one(5.0)
+    assert registered.service == svc1
+
+    rec.find(key, probe.ref)
+    listing = probe.receive_one(5.0)
+    assert listing.service_instances == frozenset({svc1})
+
+    sub = TestProbe(system)
+    rec.subscribe(key, sub.ref)
+    assert sub.receive_one(5.0).service_instances == frozenset({svc1})
+
+    svc2 = system.actor_of(Props.create(Echo), "svc2")
+    rec.register(key, svc2)
+    assert sub.receive_one(5.0).service_instances == frozenset({svc1, svc2})
+
+    # terminated services drop out
+    system.stop(svc1)
+    await_condition(lambda: _find_now(rec, system) == frozenset({svc2}),
+                    max_time=5.0)
+
+
+def _find_now(rec, system):
+    p = TestProbe(system)
+    rec.find(ServiceKey("echo-service"), p.ref)
+    return p.receive_one(3.0).service_instances
+
+
+def test_receptionist_cluster_visibility():
+    from akka_tpu.cluster import Cluster
+    from akka_tpu.remote.transport import InProcTransport
+    InProcTransport.fault_injector.reset()
+    FAST = {"akka": {"actor": {"provider": "cluster"},
+                     "stdout-loglevel": "OFF", "log-dead-letters": 0,
+                     "remote": {"transport": "inproc",
+                                "canonical": {"hostname": "local", "port": 0}},
+                     "cluster": {"gossip-interval": "0.05s",
+                                 "leader-actions-interval": "0.05s",
+                                 "distributed-data": {
+                                     "gossip-interval": "0.1s",
+                                     "notify-subscribers-interval": "0.05s",
+                                     "delta-crdt": {
+                                         "delta-propagation-interval": "0.05s"}}}}}
+    systems = [ActorSystem.create(f"rc{i}", FAST) for i in range(2)]
+    try:
+        for s in systems:
+            Cluster.get(s).join(str(systems[0].provider.local_address))
+        await_condition(
+            lambda: all(len([m for m in Cluster.get(s).state.members
+                             if m.status.value == "Up"]) == 2
+                        for s in systems), max_time=10.0)
+        key = ServiceKey("cluster-svc")
+        svc = systems[0].actor_of(Props.create(Echo), "clustered-echo")
+        Receptionist.get(systems[0]).register(key, svc)
+
+        # node 2 discovers node 1's service through replicated registry
+        def visible_on_node2():
+            p = TestProbe(systems[1])
+            Receptionist.get(systems[1]).find(key, p.ref)
+            insts = p.receive_one(3.0).service_instances
+            return len(insts) == 1
+        await_condition(visible_on_node2, max_time=10.0)
+
+        # and the resolved remote ref actually works
+        p = TestProbe(systems[1])
+        Receptionist.get(systems[1]).find(key, p.ref)
+        remote_ref = next(iter(p.receive_one(3.0).service_instances))
+        remote_ref.tell("hi", p.ref)
+        assert p.receive_one(5.0) == ("echo", "hi")
+    finally:
+        for s in systems:
+            s.terminate()
+        for s in systems:
+            s.await_termination(10.0)
+        InProcTransport.fault_injector.reset()
+
+
+# -- reliable delivery --------------------------------------------------------
+
+class Producer(Actor):
+    """Sends words on demand (reference ReliableDeliverySpec TestProducer)."""
+
+    def __init__(self, words, probe):
+        super().__init__()
+        self.words = list(words)
+        self.probe = probe
+
+    def receive(self, message):
+        if isinstance(message, RequestNext):
+            if self.words:
+                message.send_next_to.tell(self.words.pop(0), self.self_ref)
+            else:
+                self.probe.tell("producer-drained", self.self_ref)
+
+
+class Consumer(Actor):
+    """Confirms every delivery (reference TestConsumer)."""
+
+    def __init__(self, probe):
+        super().__init__()
+        self.probe = probe
+
+    def receive(self, message):
+        if isinstance(message, Delivery):
+            self.probe.tell(("delivered", message.seq_nr, message.message),
+                            self.self_ref)
+            message.confirm_to.tell(Confirmed(), self.self_ref)
+
+
+def test_reliable_delivery_point_to_point(system):
+    probe = TestProbe(system)
+    pc = system.actor_of(producer_controller_props("p1"), "pc")
+    cc = system.actor_of(consumer_controller_props(flow_control_window=5),
+                         "cc")
+    consumer = system.actor_of(Props.create(Consumer, probe.ref))
+    producer = system.actor_of(Props.create(
+        Producer, ["a", "b", "c", "d", "e", "f"], probe.ref))
+
+    cc.tell(Start(consumer), None)
+    cc.tell(RegisterToProducerController(pc), None)
+    pc.tell(Start(producer), None)
+
+    got = []
+    while len(got) < 6:
+        m = probe.receive_one(5.0)
+        if isinstance(m, tuple) and m[0] == "delivered":
+            got.append(m)
+    assert [g[2] for g in got] == ["a", "b", "c", "d", "e", "f"]
+    assert [g[1] for g in got] == [1, 2, 3, 4, 5, 6]  # sequenced, in order
+
+
+def test_reliable_delivery_with_confirmation_ask(system):
+    probe = TestProbe(system)
+    reply_probe = TestProbe(system)
+    pc = system.actor_of(producer_controller_props("p2"))
+    cc = system.actor_of(consumer_controller_props())
+    consumer = system.actor_of(Props.create(Consumer, probe.ref))
+    cc.tell(Start(consumer), None)
+    cc.tell(RegisterToProducerController(pc), None)
+
+    # MessageWithConfirmation: reply arrives once the consumer confirmed
+    pc.tell(MessageWithConfirmation("important", reply_probe.ref), None)
+    assert probe.receive_one(5.0)[2] == "important"
+    assert reply_probe.receive_one(5.0) == 1  # confirmed seq nr
+
+
+def test_reliable_delivery_durable_queue_resends_after_restart(system):
+    """Unconfirmed messages survive a producer-controller restart
+    (reference: EventSourcedProducerQueue)."""
+    probe = TestProbe(system)
+    pc1 = system.actor_of(producer_controller_props(
+        "p3", durable_queue_name="dq-test"), "pc-durable-1")
+    producer = system.actor_of(Props.create(Producer, ["x", "y"], probe.ref))
+    pc1.tell(Start(producer), None)
+    # NO consumer yet: messages stored durable + unconfirmed... but demand
+    # only opens when a consumer registers, so attach one that DROPS
+    # deliveries (never confirms) to get messages in flight
+    class DroppingConsumer(Actor):
+        def receive(self, message):
+            pass
+    cc1 = system.actor_of(consumer_controller_props(), "cc-durable-1")
+    cc1.tell(Start(system.actor_of(Props.create(DroppingConsumer))), None)
+    cc1.tell(RegisterToProducerController(pc1), None)
+    time.sleep(0.5)  # x persisted to the durable queue, never confirmed
+    system.stop(pc1)
+    system.stop(cc1)
+
+    # new incarnation with the same durable queue name: x is redelivered
+    pc2 = system.actor_of(producer_controller_props(
+        "p3", durable_queue_name="dq-test"), "pc-durable-2")
+    cc2 = system.actor_of(consumer_controller_props(), "cc-durable-2")
+    consumer = system.actor_of(Props.create(Consumer, probe.ref))
+    cc2.tell(Start(consumer), None)
+    cc2.tell(RegisterToProducerController(pc2), None)
+    while True:
+        got = probe.receive_one(10.0)
+        if isinstance(got, tuple) and got[0] == "delivered":
+            break
+    assert got[2] == "x"
+
+
+class Worker(Actor):
+    def __init__(self, name, probe):
+        super().__init__()
+        self.name_ = name
+        self.probe = probe
+
+    def receive(self, message):
+        if isinstance(message, Delivery):
+            self.probe.tell((self.name_, message.message), self.self_ref)
+            message.confirm_to.tell(Confirmed(), self.self_ref)
+
+
+class JobProducer(Actor):
+    def __init__(self, jobs):
+        super().__init__()
+        self.jobs = list(jobs)
+
+    def receive(self, message):
+        if isinstance(message, WorkPullingRequestNext):
+            if self.jobs:
+                message.send_next_to.tell(self.jobs.pop(0), self.self_ref)
+
+
+def test_work_pulling(system):
+    probe = TestProbe(system)
+    key = ServiceKey("workers")
+    rec = Receptionist.get(system)
+
+    # two workers, each with its own consumer controller
+    for i in range(2):
+        cc = system.actor_of(consumer_controller_props(), f"wp-cc{i}")
+        worker = system.actor_of(Props.create(Worker, f"w{i}", probe.ref))
+        cc.tell(Start(worker), None)
+        rec.register(key, cc)
+
+    wp = system.actor_of(work_pulling_producer_props("wp1", key), "wp")
+    producer = system.actor_of(Props.create(JobProducer,
+                                            [f"job{i}" for i in range(6)]))
+    wp.tell(Start(producer), None)
+
+    got = [probe.receive_one(5.0) for _ in range(6)]
+    assert sorted(j for _, j in got) == [f"job{i}" for i in range(6)]
+    workers_used = {w for w, _ in got}
+    assert workers_used <= {"w0", "w1"} and workers_used
+
+
+# -- typed pub-sub topic ------------------------------------------------------
+
+def test_topic_pubsub(system):
+    topic = Topic.create(system, "news")
+    p1, p2 = TestProbe(system), TestProbe(system)
+    topic.tell(TopicSubscribe(p1.ref), None)
+    topic.tell(TopicSubscribe(p2.ref), None)
+    time.sleep(0.2)  # receptionist listing settles
+    topic.tell(Publish("hello"), None)
+    assert p1.receive_one(5.0) == "hello"
+    assert p2.receive_one(5.0) == "hello"
+
+
+# -- stream-typed adapters ----------------------------------------------------
+
+def test_actor_source_and_acked_sink(system):
+    from akka_tpu.stream import Keep, Sink, Source
+    from akka_tpu.stream.typed import ActorSink, ActorSource
+
+    pair = ActorSource.actor_ref(
+        complete_matcher=lambda m: m == "DONE",
+        failure_matcher=lambda m: None, buffer_size=64) \
+        .to_mat(Sink.seq(), Keep.both).run(system)
+    ref, fut = pair
+    time.sleep(0.1)
+    ref.tell("a")
+    ref.tell("b")
+    ref.tell("DONE")
+    assert fut.result(5.0) == ["a", "b"]
+
+    # ack-based sink: target must ack each element before the next arrives
+    class AckingTarget(Actor):
+        def __init__(self, probe):
+            super().__init__()
+            self.probe = probe
+
+        def receive(self, message):
+            if message == "init" or message == "done":
+                self.probe.tell(message, self.self_ref)
+                if message == "init":
+                    self.sender.tell("ACK", self.self_ref)
+            else:
+                self.probe.tell(("elem", message), self.self_ref)
+                self.sender.tell("ACK", self.self_ref)
+
+    probe = TestProbe(system)
+    target = system.actor_of(Props.create(AckingTarget, probe.ref))
+    Source.from_iterable([1, 2, 3]).to(
+        ActorSink.actor_ref_with_backpressure(
+            target, message_adapter=None, on_init_message="init",
+            ack_message="ACK", on_complete_message="done"),
+        Keep.right).run(system)
+    assert probe.receive_one(5.0) == "init"
+    assert probe.receive_one(5.0) == ("elem", 1)
+    assert probe.receive_one(5.0) == ("elem", 2)
+    assert probe.receive_one(5.0) == ("elem", 3)
+    assert probe.receive_one(5.0) == "done"
