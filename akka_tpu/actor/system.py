@@ -134,10 +134,25 @@ class ActorSystem:
         self._stdout_logger = StdOutLogger(level_for(self.settings.stdout_loglevel))
         self.event_stream.attach_tap(self._stdout_filtered)
 
-        self.scheduler = Scheduler(
-            tick_duration=cfg.get_duration("akka.scheduler.tick-duration", "10ms"),
-            ticks_per_wheel=cfg.get_int("akka.scheduler.ticks-per-wheel", 512),
-            name=f"akka-tpu-scheduler-{name}")
+        sched_impl = cfg.get_string("akka.scheduler.implementation", "default")
+        self.scheduler = None
+        if sched_impl == "native":
+            # the C++ hashed-wheel (LightArrayRevolverScheduler parity);
+            # silently falls back when no compiler is available
+            try:
+                from ..native.integration import NativeScheduler
+                self.scheduler = NativeScheduler(
+                    tick_duration=cfg.get_duration(
+                        "akka.scheduler.tick-duration", "10ms"),
+                    ticks_per_wheel=cfg.get_int(
+                        "akka.scheduler.ticks-per-wheel", 512))
+            except Exception:  # noqa: BLE001
+                self.scheduler = None
+        if self.scheduler is None:
+            self.scheduler = Scheduler(
+                tick_duration=cfg.get_duration("akka.scheduler.tick-duration", "10ms"),
+                ticks_per_wheel=cfg.get_int("akka.scheduler.ticks-per-wheel", 512),
+                name=f"akka-tpu-scheduler-{name}")
 
         self.dispatchers = Dispatchers(self.settings, self)
         # register the flagship TPU dispatcher type (extension seam per
@@ -148,6 +163,12 @@ class ActorSystem:
         except ImportError:  # jax unavailable in minimal envs; host path still works
             pass
         self.mailboxes = Mailboxes(self.settings, self.event_stream)
+        if cfg.get_bool("akka.actor.native-mailboxes"):
+            try:
+                from ..native.integration import register_native_mailbox
+                register_native_mailbox(self.mailboxes)
+            except Exception:  # noqa: BLE001 — no compiler: python queues only
+                pass
 
         provider_kind = self.settings.provider_kind
         if provider_kind in ("remote", "cluster"):

@@ -22,6 +22,7 @@ in-flight message and runs every live actor's update, entirely on device.
 
 from __future__ import annotations
 
+import os
 import threading
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -46,7 +47,8 @@ class BatchedSystem:
                  payload_width: int = 4, out_degree: int = 1,
                  host_inbox: int = 1024, payload_dtype=jnp.float32,
                  device: Optional[Any] = None, delivery: str = "sort",
-                 need_max: bool = False, topology=None):
+                 need_max: bool = False, topology=None,
+                 native_staging: Optional[bool] = None):
         if not behaviors:
             raise ValueError("at least one behavior required")
         self.capacity = int(capacity)
@@ -88,6 +90,21 @@ class BatchedSystem:
         self._host_staged: List[Tuple[int, np.ndarray]] = []
         self._lock = threading.Lock()
         self.dropped_messages = 0
+        # native staging buffer: producers memcpy rows into a preallocated
+        # C++ buffer with one atomic reserve, the flush drains a contiguous
+        # block (SURVEY.md §2.10 item 5 — envelope-pool parity). Opt-out via
+        # native_staging=False or AKKA_TPU_NATIVE=0; falls back to the
+        # Python staging list when the library isn't available.
+        self._stager = None
+        if native_staging is not False and \
+                os.environ.get("AKKA_TPU_NATIVE", "1") != "0":
+            try:
+                from ..native.queues import NativeStager
+                self._stager = NativeStager(
+                    self.host_inbox, self.payload_width,
+                    np.dtype(jnp.dtype(payload_dtype)))
+            except Exception:  # noqa: BLE001 — no compiler / odd dtype
+                self._stager = None
 
         # topology tables ride as runtime arguments (pytree): closure
         # constants would be baked into the HLO (multi-MB programs break
@@ -132,13 +149,21 @@ class BatchedSystem:
         dst: int or [k] array; payload: [P] or [k, P]."""
         dst_arr = np.atleast_1d(np.asarray(dst, dtype=np.int32))
         pl = np.asarray(payload, dtype=jnp.dtype(self.payload_dtype))
-        if pl.ndim == 1 and dst_arr.shape[0] == 1:
-            pl = pl[None, :]
+        if pl.ndim == 1:
+            # broadcast a single payload row to every destination — the
+            # native stager memcpys k full rows, so the buffer must hold k
+            pl = np.broadcast_to(pl[None, :],
+                                 (dst_arr.shape[0], pl.shape[0]))
         if pl.shape[-1] != self.payload_width:
             pad = self.payload_width - pl.shape[-1]
             if pad < 0:
                 raise ValueError(f"payload wider than {self.payload_width}")
             pl = np.pad(pl, [(0, 0)] * (pl.ndim - 1) + [(0, pad)])
+        if self._stager is not None:
+            staged = self._stager.stage(dst_arr, pl)
+            if staged < dst_arr.shape[0]:
+                self.dropped_messages += dst_arr.shape[0] - staged
+            return
         with self._lock:
             for d, p in zip(dst_arr, pl):
                 self._host_staged.append((int(d), p))
@@ -159,6 +184,17 @@ class BatchedSystem:
         self.inbox_valid = self.inbox_valid.at[:k].set(True)
 
     def _flush_staged(self) -> None:
+        if self._stager is not None:
+            dsts_np, pls_np = self._stager.drain()
+            if dsts_np.shape[0] == 0:
+                return
+            base = self.capacity * self.out_degree
+            idx = jnp.arange(base, base + dsts_np.shape[0])
+            self.inbox_dst = self.inbox_dst.at[idx].set(jnp.asarray(dsts_np))
+            self.inbox_payload = self.inbox_payload.at[idx].set(
+                jnp.asarray(pls_np, self.payload_dtype))
+            self.inbox_valid = self.inbox_valid.at[idx].set(True)
+            return
         with self._lock:
             staged, self._host_staged = self._host_staged, []
         if not staged:

@@ -1,0 +1,26 @@
+"""Native (C++) runtime substrate.
+
+Where the reference leans on JVM intrinsics (sun.misc.Unsafe, lock-free
+AbstractNodeQueue mailboxes, the LightArrayRevolverScheduler wheel, Artery
+envelope buffer pools), akka-tpu uses a small C++ library bound via ctypes:
+lock-free MPSC mailbox queues, a hashed-wheel timer with a native tick
+thread, and a preallocated message stager feeding the batched device
+runtime. Built on demand with g++; everything falls back to pure Python
+when unavailable (`available()`).
+"""
+
+from .lib import available  # noqa: F401
+from .integration import (NativeScheduler, NativeUnboundedMailbox,  # noqa: F401
+                          register_native_mailbox)
+
+__all__ = ["available", "NativeScheduler", "NativeUnboundedMailbox",
+           "register_native_mailbox"]
+
+
+def __getattr__(name):
+    # NativeMpscQueue etc. require the built library; import lazily so
+    # importing akka_tpu.native never fails without a compiler
+    if name in ("NativeMpscQueue", "NativeWheelTimer", "NativeStager"):
+        from . import queues
+        return getattr(queues, name)
+    raise AttributeError(name)

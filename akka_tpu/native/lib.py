@@ -1,0 +1,100 @@
+"""Build + ctypes bindings for the native runtime library.
+
+Compiles akka_native.cpp with g++ on first use (cached as a .so next to the
+package; rebuilt when the source changes). pybind11 is not in the image, so
+the C ABI + ctypes is the binding layer. Everything degrades gracefully:
+`available()` is False when no compiler is present and all consumers fall
+back to pure-Python implementations.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src", "akka_native.cpp")
+_BUILD_DIR = os.path.join(_HERE, "_build")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _so_path() -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha1(f.read()).hexdigest()[:12]
+    return os.path.join(_BUILD_DIR, f"libakka_native-{digest}.so")
+
+
+def _build() -> Optional[str]:
+    so = _so_path()
+    if os.path.exists(so):
+        return so
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    tmp = so + ".tmp"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-o", tmp, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (subprocess.CalledProcessError, FileNotFoundError,
+            subprocess.TimeoutExpired):
+        return None
+    os.replace(tmp, so)
+    return so
+
+
+def get() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        so = _build()
+        if so is None:
+            return None
+        lib = ctypes.CDLL(so)
+        # -- signatures --------------------------------------------------
+        u64, i64, i32p, u64p, u8p, voidp = (
+            ctypes.c_uint64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_void_p)
+        lib.aq_mpsc_create.restype = voidp
+        lib.aq_mpsc_enqueue.argtypes = [voidp, u64]
+        lib.aq_mpsc_dequeue.argtypes = [voidp, u64p]
+        lib.aq_mpsc_dequeue.restype = ctypes.c_int
+        lib.aq_mpsc_count.argtypes = [voidp]
+        lib.aq_mpsc_count.restype = i64
+        lib.aq_mpsc_drain.argtypes = [voidp, u64p, i64]
+        lib.aq_mpsc_drain.restype = i64
+        lib.aq_mpsc_destroy.argtypes = [voidp]
+
+        lib.aq_timer_create.argtypes = [u64, u64]
+        lib.aq_timer_create.restype = voidp
+        lib.aq_timer_schedule.argtypes = [voidp, u64, u64, u64]
+        lib.aq_timer_cancel.argtypes = [voidp, u64]
+        lib.aq_timer_poll.argtypes = [voidp, u64p, i64, i64]
+        lib.aq_timer_poll.restype = i64
+        lib.aq_timer_destroy.argtypes = [voidp]
+
+        lib.aq_stager_create.argtypes = [i64, i64]
+        lib.aq_stager_create.restype = voidp
+        lib.aq_stager_stage.argtypes = [voidp, i64, i32p, u8p]
+        lib.aq_stager_stage.restype = i64
+        lib.aq_stager_count.argtypes = [voidp]
+        lib.aq_stager_count.restype = i64
+        lib.aq_stager_dropped.argtypes = [voidp]
+        lib.aq_stager_dropped.restype = i64
+        lib.aq_stager_drain.argtypes = [voidp, i32p, u8p]
+        lib.aq_stager_drain.restype = i64
+        lib.aq_stager_destroy.argtypes = [voidp]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get() is not None

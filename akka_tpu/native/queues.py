@@ -1,0 +1,176 @@
+"""Python wrappers over the native substrate: MPSC mailbox queue, wheel
+timer, and the message stager.
+
+Reference parity notes are in src/akka_native.cpp. The token registry trick:
+the C queue carries uint64 tokens; the Python side keeps token -> object in
+a dict (dict mutation is atomic under the GIL), so arbitrary messages ride
+the lock-free queue without the C side touching refcounts.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import itertools
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import lib as _libmod
+
+
+class NativeMpscQueue:
+    """Lock-free MPSC queue of Python objects (AbstractNodeQueue parity)."""
+
+    def __init__(self):
+        self._lib = _libmod.get()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self._h = self._lib.aq_mpsc_create()
+        self._tokens = itertools.count(1)
+        self._registry: Dict[int, Any] = {}
+        self._out = (ctypes.c_uint64 * 1)()
+
+    def enqueue(self, obj: Any) -> None:
+        if self._h is None:
+            return  # closed (actor stopped): drop, mirrors dead-letter path
+        tok = next(self._tokens)
+        self._registry[tok] = obj
+        self._lib.aq_mpsc_enqueue(self._h, tok)
+
+    def dequeue(self) -> Optional[Any]:
+        if self._h is None:
+            return None
+        if self._lib.aq_mpsc_dequeue(self._h, self._out):
+            return self._registry.pop(int(self._out[0]))
+        return None
+
+    def __len__(self) -> int:
+        if self._h is None:
+            return 0
+        return int(self._lib.aq_mpsc_count(self._h))
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.aq_mpsc_destroy(self._h)
+            self._h = None
+            self._registry.clear()
+
+    def __del__(self):  # backstop: actors drop their queue on stop
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+class NativeWheelTimer:
+    """Hashed-wheel timer driven by a native tick thread; callbacks run on a
+    single Python poller thread (LightArrayRevolverScheduler parity)."""
+
+    def __init__(self, tick_duration: float = 0.001, wheel_size: int = 512):
+        self._lib = _libmod.get()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self._h = self._lib.aq_timer_create(int(tick_duration * 1e9),
+                                            wheel_size)
+        self._ids = itertools.count(1)
+        self._callbacks: Dict[int, Tuple[Callable[[], None], bool]] = {}
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._poller = threading.Thread(target=self._run,
+                                        name="akka-tpu-native-timer",
+                                        daemon=True)
+        self._poller.start()
+
+    def schedule_once(self, delay: float, fn: Callable[[], None]) -> int:
+        tid = next(self._ids)
+        with self._lock:
+            self._callbacks[tid] = (fn, False)
+        self._lib.aq_timer_schedule(self._h, tid, int(max(delay, 0) * 1e9), 0)
+        return tid
+
+    def schedule_periodically(self, initial: float, interval: float,
+                              fn: Callable[[], None]) -> int:
+        tid = next(self._ids)
+        with self._lock:
+            self._callbacks[tid] = (fn, True)
+        self._lib.aq_timer_schedule(self._h, tid, int(max(initial, 0) * 1e9),
+                                    int(max(interval, 1e-4) * 1e9))
+        return tid
+
+    def cancel(self, tid: int) -> None:
+        with self._lock:
+            self._callbacks.pop(tid, None)
+        self._lib.aq_timer_cancel(self._h, tid)
+
+    def _run(self) -> None:
+        buf = (ctypes.c_uint64 * 256)()
+        while not self._stopped.is_set():
+            n = self._lib.aq_timer_poll(self._h, buf, 256, 200)
+            for i in range(n):
+                with self._lock:
+                    entry = self._callbacks.get(int(buf[i]))
+                    if entry is not None and not entry[1]:
+                        del self._callbacks[int(buf[i])]
+                if entry is not None:
+                    try:
+                        entry[0]()
+                    except Exception:  # noqa: BLE001 — timer cbs must not die
+                        pass
+
+    def shutdown(self) -> None:
+        self._stopped.set()
+        self._poller.join(timeout=2.0)
+        if self._poller.is_alive():
+            # a callback is blocking the poller: leak the native handle
+            # instead of freeing memory it will touch (no use-after-free)
+            return
+        self._lib.aq_timer_destroy(self._h)
+
+
+class NativeStager:
+    """Preallocated staging buffer for batched-runtime tells: producers on
+    any thread memcpy fixed-width rows in, the step loop drains one
+    contiguous block (EnvelopeBufferPool parity)."""
+
+    def __init__(self, capacity: int, payload_width: int, dtype=np.float32):
+        self._lib = _libmod.get()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self.capacity = capacity
+        self.payload_width = payload_width
+        self.dtype = np.dtype(dtype)
+        self.row_bytes = payload_width * self.dtype.itemsize
+        self._h = self._lib.aq_stager_create(capacity, self.row_bytes)
+        # reusable drain buffers (zero allocation per drain)
+        self._dst_out = np.empty(capacity, np.int32)
+        self._payload_out = np.empty((capacity, payload_width), self.dtype)
+
+    def stage(self, dsts: np.ndarray, payloads: np.ndarray) -> int:
+        dsts = np.ascontiguousarray(dsts, np.int32)
+        payloads = np.ascontiguousarray(payloads, self.dtype)
+        k = dsts.shape[0]
+        return int(self._lib.aq_stager_stage(
+            self._h, k,
+            dsts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            payloads.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))))
+
+    def __len__(self) -> int:
+        return int(self._lib.aq_stager_count(self._h))
+
+    @property
+    def dropped(self) -> int:
+        return int(self._lib.aq_stager_dropped(self._h))
+
+    def drain(self) -> Tuple[np.ndarray, np.ndarray]:
+        n = int(self._lib.aq_stager_drain(
+            self._h,
+            self._dst_out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            self._payload_out.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_uint8))))
+        return self._dst_out[:n], self._payload_out[:n]
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.aq_stager_destroy(self._h)
+            self._h = None
