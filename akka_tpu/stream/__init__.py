@@ -21,6 +21,7 @@ from .killswitch import (KillSwitches, SharedKillSwitch,  # noqa: F401
                          UniqueKillSwitch)
 from .hub import BroadcastHub, MergeHub  # noqa: F401
 from .device import DevicePipeline  # noqa: F401
+from .streamref import SinkRef, SourceRef, StreamRefs  # noqa: F401
 from .ops import _QUEUE_END as QUEUE_END  # noqa: F401
 
 __all__ = [
@@ -34,4 +35,5 @@ __all__ = [
     "NoSuchElementException", "BufferOverflowException",
     "KillSwitches", "UniqueKillSwitch", "SharedKillSwitch",
     "MergeHub", "BroadcastHub", "DevicePipeline",
+    "StreamRefs", "SourceRef", "SinkRef",
 ]
