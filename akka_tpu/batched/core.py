@@ -89,7 +89,11 @@ class BatchedSystem:
         self._free_rows: List[int] = []
         self._host_staged: List[Tuple[int, np.ndarray]] = []
         self._lock = threading.Lock()
-        self.dropped_messages = 0
+        self._dropped_host = 0  # guarded by _lock; stager drops counted natively
+        # overflow visibility hook (bounded-mailbox dead-letter parity,
+        # dispatch/Mailbox.scala:415-443): the dispatcher bridge wires this
+        # to the EventStream so host_inbox overflow surfaces as Dropped
+        self.on_dropped: Optional[Callable[[int], None]] = None
         # native staging buffer: producers memcpy rows into a preallocated
         # C++ buffer with one atomic reserve, the flush drains a contiguous
         # block (SURVEY.md §2.10 item 5 — envelope-pool parity). Opt-out via
@@ -161,8 +165,8 @@ class BatchedSystem:
             pl = np.pad(pl, [(0, 0)] * (pl.ndim - 1) + [(0, pad)])
         if self._stager is not None:
             staged = self._stager.stage(dst_arr, pl)
-            if staged < dst_arr.shape[0]:
-                self.dropped_messages += dst_arr.shape[0] - staged
+            if staged < dst_arr.shape[0] and self.on_dropped is not None:
+                self.on_dropped(dst_arr.shape[0] - staged)
             return
         with self._lock:
             for d, p in zip(dst_arr, pl):
@@ -200,7 +204,11 @@ class BatchedSystem:
         if not staged:
             return
         if len(staged) > self.host_inbox:
-            self.dropped_messages += len(staged) - self.host_inbox
+            n_drop = len(staged) - self.host_inbox
+            with self._lock:
+                self._dropped_host += n_drop
+            if self.on_dropped is not None:
+                self.on_dropped(n_drop)
             staged = staged[: self.host_inbox]
         base = self.capacity * self.out_degree
         idx = jnp.arange(base, base + len(staged))
@@ -328,6 +336,16 @@ class BatchedSystem:
         if ids is not None:
             arr = arr[jnp.asarray(ids)]
         return np.asarray(jax.device_get(arr))
+
+    @property
+    def dropped_messages(self) -> int:
+        """Total host tells dropped on overflow. Derived from the stager's
+        atomic counter (no racy Python increments — ADVICE r1) plus the
+        lock-guarded Python-path count."""
+        n = self._dropped_host
+        if self._stager is not None:
+            n += self._stager.dropped
+        return n
 
     @property
     def live_count(self) -> int:

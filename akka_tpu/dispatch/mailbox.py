@@ -423,19 +423,41 @@ class Mailbox:
                 return
 
     def clean_up(self) -> None:
-        """Move remaining messages to dead letters after close
-        (reference: Mailbox.scala:332-360)."""
+        """Move remaining messages to dead letters after close, then let the
+        queue release its backing resources via the MessageQueue.clean_up SPI
+        (reference: Mailbox.scala:332-360 delegating to
+        messageQueue.cleanUp(actor.self, deadLetterMailbox.messageQueue))."""
         if self.actor is None:
             return
         system = self.actor.system
         dl = system.dead_letters
         for msg in self.system_drain():
             dl.tell(msg, self.actor.self_ref)
-        while True:
-            env = self.dequeue()
-            if env is None:
-                break
-            dl.tell(DeadLetter(env.message, env.sender, self.actor.self_ref), env.sender)
+        self.message_queue.clean_up(
+            self.actor.self_ref, _DeadLetterSink(dl, self.actor.self_ref))
+
+
+class _DeadLetterSink(MessageQueue):
+    """Adapter presenting the dead-letters ActorRef as the MessageQueue that
+    MessageQueue.clean_up drains into (the deadLetterMailbox.messageQueue
+    role in the reference)."""
+
+    __slots__ = ("_dl", "_owner")
+
+    def __init__(self, dead_letters_ref: Any, owner_ref: Any) -> None:
+        self._dl = dead_letters_ref
+        self._owner = owner_ref
+
+    def enqueue(self, receiver: Any, handle: Envelope) -> None:
+        self._dl.tell(DeadLetter(handle.message, handle.sender, self._owner),
+                      handle.sender)
+
+    def dequeue(self) -> Optional[Envelope]:
+        return None
+
+    @property
+    def number_of_messages(self) -> int:
+        return 0
 
 
 # -- mailbox type registry (reference: dispatch/Mailboxes.scala:91) ---------

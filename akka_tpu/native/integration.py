@@ -35,8 +35,11 @@ class NativeMessageQueue(MessageQueue):
         return len(self._q)
 
     def clean_up(self, owner: Any, dead_letters: MessageQueue) -> None:
+        """On actor stop: drain to dead letters, then mark the native queue
+        closed so late tells take the safe no-op path. Memory is reclaimed
+        by NativeMpscQueue.__del__ once no producer can hold the handle."""
         super().clean_up(owner, dead_letters)
-        self._q.close()  # free the native handle when the actor stops
+        self._q.close()
 
 
 class NativeUnboundedMailbox(MailboxType):

@@ -71,6 +71,7 @@ def get() -> Optional[ctypes.CDLL]:
         lib.aq_mpsc_count.restype = i64
         lib.aq_mpsc_drain.argtypes = [voidp, u64p, i64]
         lib.aq_mpsc_drain.restype = i64
+        lib.aq_mpsc_close.argtypes = [voidp]
         lib.aq_mpsc_destroy.argtypes = [voidp]
 
         lib.aq_timer_create.argtypes = [u64, u64]

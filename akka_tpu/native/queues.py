@@ -27,38 +27,53 @@ class NativeMpscQueue:
         if self._lib is None:
             raise RuntimeError("native library unavailable")
         self._h = self._lib.aq_mpsc_create()
+        self._closed = False
         self._tokens = itertools.count(1)
         self._registry: Dict[int, Any] = {}
         self._out = (ctypes.c_uint64 * 1)()
 
     def enqueue(self, obj: Any) -> None:
-        if self._h is None:
+        if self._closed:
             return  # closed (actor stopped): drop, mirrors dead-letter path
         tok = next(self._tokens)
         self._registry[tok] = obj
+        # safe vs concurrent close(): close only sets the closed flag (no
+        # free, no drain — a drain would be a second consumer); memory is
+        # freed in __del__, which cannot run while this frame holds a ref
         self._lib.aq_mpsc_enqueue(self._h, tok)
+        if self._closed:
+            self._registry.pop(tok, None)
 
     def dequeue(self) -> Optional[Any]:
-        if self._h is None:
+        if self._closed:
             return None
         if self._lib.aq_mpsc_dequeue(self._h, self._out):
-            return self._registry.pop(int(self._out[0]))
+            obj = self._registry.pop(int(self._out[0]), None)
+            if obj is not None:
+                return obj
         return None
 
     def __len__(self) -> int:
-        if self._h is None:
+        if self._closed:
             return 0
         return int(self._lib.aq_mpsc_count(self._h))
 
     def close(self) -> None:
-        if self._h:
-            self._lib.aq_mpsc_destroy(self._h)
-            self._h = None
+        """Mark closed; late tells become safe no-ops. Nothing is freed or
+        drained here: a drain would race the consumer's in-flight dequeue
+        (two consumers on a single-consumer queue), and freeing would race
+        producers mid-enqueue (ADVICE r1). Reclamation happens in __del__
+        when no reference — hence no in-flight caller — remains."""
+        if not self._closed:
+            self._closed = True
+            self._lib.aq_mpsc_close(self._h)
             self._registry.clear()
 
-    def __del__(self):  # backstop: actors drop their queue on stop
+    def __del__(self):  # true reclamation: no refs => no in-flight producers
         try:
-            self.close()
+            if self._h:
+                self._lib.aq_mpsc_destroy(self._h)
+                self._h = None
         except Exception:  # noqa: BLE001 — interpreter teardown
             pass
 

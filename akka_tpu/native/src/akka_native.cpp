@@ -42,6 +42,7 @@ struct MpscQueue {
     MpscNode* tail;               // consumer pops here
     MpscNode stub;
     std::atomic<int64_t> size;
+    std::atomic<bool> closed;     // late sends no-op (becomeClosed parity)
 };
 
 void* aq_mpsc_create() {
@@ -50,11 +51,13 @@ void* aq_mpsc_create() {
     q->head.store(&q->stub, std::memory_order_relaxed);
     q->tail = &q->stub;
     q->size.store(0, std::memory_order_relaxed);
+    q->closed.store(false, std::memory_order_relaxed);
     return q;
 }
 
 void aq_mpsc_enqueue(void* h, uint64_t v) {
     auto* q = static_cast<MpscQueue*>(h);
+    if (q->closed.load(std::memory_order_acquire)) return;
     auto* n = new MpscNode();
     n->value = v;
     n->next.store(nullptr, std::memory_order_relaxed);
@@ -86,10 +89,22 @@ int64_t aq_mpsc_drain(void* h, uint64_t* out, int64_t max) {
     return n;
 }
 
+// Mark closed: late producers no-op. Flag-only on purpose — draining here
+// would make close a second concurrent consumer racing the real consumer's
+// dequeue (double-delete of q->tail on a Vyukov queue). Queued nodes and
+// the struct are reclaimed in aq_mpsc_destroy, called only when no thread
+// can hold the handle — mirrors the reference's becomeClosed mailbox swap
+// routing late senders to dead letters.
+void aq_mpsc_close(void* h) {
+    static_cast<MpscQueue*>(h)->closed.store(true, std::memory_order_release);
+}
+
 void aq_mpsc_destroy(void* h) {
     auto* q = static_cast<MpscQueue*>(h);
     uint64_t scratch;
     while (aq_mpsc_dequeue(h, &scratch)) {}
+    // dequeue defers deleting the node it leaves as tail; reclaim it
+    if (q->tail != &q->stub) delete q->tail;
     delete q;
 }
 
@@ -97,7 +112,7 @@ void aq_mpsc_destroy(void* h) {
 
 struct TimerEntry {
     uint64_t id;
-    uint64_t rounds;      // full wheel revolutions left
+    uint64_t deadline_tick;   // absolute tick at which to fire
     uint64_t interval_ticks;  // 0 = one-shot
     bool cancelled;
 };
@@ -122,14 +137,20 @@ struct WheelTimer {
             current_tick++;
             auto& slot = wheel[current_tick & wheel_mask];
             bool any = false;
+            // Reschedules are collected and appended AFTER the iteration:
+            // pushing into the slot being walked would re-visit an entry in
+            // the same pass (an exact-multiple interval lands back in this
+            // slot), firing and re-appending forever. Absolute deadlines
+            // (not revolution counts) make same-slot entries with a future
+            // deadline simply skip until their tick arrives.
+            std::vector<TimerEntry> resched;
             for (size_t i = 0; i < slot.size();) {
                 TimerEntry& e = slot[i];
                 if (e.cancelled) {
                     slot.erase(slot.begin() + i);
                     continue;
                 }
-                if (e.rounds > 0) {
-                    e.rounds--;
+                if (e.deadline_tick > current_tick) {
                     i++;
                     continue;
                 }
@@ -137,15 +158,13 @@ struct WheelTimer {
                 any = true;
                 if (e.interval_ticks > 0) {
                     TimerEntry re = e;
-                    uint64_t target = current_tick + re.interval_ticks;
-                    // slot is first reached after ((ticks-1) % wheel)+1
-                    // ticks, so an exact-multiple interval needs one fewer
-                    // revolution (mirrors the Python wheel's _place)
-                    re.rounds = (re.interval_ticks - 1) / (wheel_mask + 1);
-                    wheel[target & wheel_mask].push_back(re);
+                    re.deadline_tick = current_tick + re.interval_ticks;
+                    resched.push_back(re);
                 }
                 slot.erase(slot.begin() + i);
             }
+            for (auto& re : resched)
+                wheel[re.deadline_tick & wheel_mask].push_back(re);
             if (any) fired_cv.notify_all();
         }
         fired_cv.notify_all();
@@ -174,7 +193,7 @@ void aq_timer_schedule(void* h, uint64_t id, uint64_t delay_ns,
     uint64_t target = t->current_tick + delay_ticks;
     TimerEntry e;
     e.id = id;
-    e.rounds = (delay_ticks - 1) / (t->wheel_mask + 1);
+    e.deadline_tick = target;
     e.interval_ticks = interval_ns ? (interval_ns / t->tick_ns ? interval_ns / t->tick_ns : 1) : 0;
     e.cancelled = false;
     t->wheel[target & t->wheel_mask].push_back(e);

@@ -187,3 +187,72 @@ def test_batched_system_uses_native_stager():
     valid = _np.asarray(sys_.inbox_valid)
     base = sys_.capacity * sys_.out_degree
     assert valid[base:base + 8].all()
+
+
+def test_wheel_timer_interval_exact_wheel_multiple():
+    """Regression (ADVICE r1): a periodic interval that is an exact multiple
+    of the wheel size used to be re-appended into the slot being iterated
+    with rounds==0, firing and re-appending forever (tick thread livelock
+    while holding the wheel mutex). With absolute deadlines + deferred
+    reschedule, it must fire once per interval and stay responsive."""
+    from akka_tpu.native.queues import NativeWheelTimer
+    # wheel_size=8 ticks of 2ms -> one revolution = 16ms; interval = exactly
+    # one revolution (and a second timer at two revolutions)
+    t = NativeWheelTimer(tick_duration=0.002, wheel_size=8)
+    one_rev, two_rev = [], []
+    p1 = t.schedule_periodically(0.016, 0.016, lambda: one_rev.append(1))
+    p2 = t.schedule_periodically(0.032, 0.032, lambda: two_rev.append(1))
+    time.sleep(0.25)
+    # schedule/cancel must not block (the old bug hung the mutex)
+    start = time.monotonic()
+    t.cancel(p1)
+    t.cancel(p2)
+    assert time.monotonic() - start < 1.0
+    # ~15 one-rev fires in 250ms; the bug produced hundreds (or a hang)
+    assert 5 <= len(one_rev) <= 25
+    # two-revolution interval must NOT fire one revolution early
+    assert 3 <= len(two_rev) <= 12
+    t.shutdown()
+
+
+def test_mpsc_close_races_with_producers_and_consumer():
+    """Regression (ADVICE r1): close() while producers are mid-tell and the
+    consumer is mid-dequeue must not free or drain under them (close is
+    flag-only; reclamation deferred to __del__). Late enqueues are safe
+    no-ops that leave no registry garbage."""
+    from akka_tpu.native.queues import NativeMpscQueue
+    for _ in range(5):
+        q = NativeMpscQueue()
+        stop = threading.Event()
+        consumed = []
+
+        def produce():
+            i = 0
+            while not stop.is_set():
+                q.enqueue(i)
+                i += 1
+
+        def consume():
+            while not stop.is_set():
+                m = q.dequeue()
+                if m is not None:
+                    consumed.append(m)
+
+        threads = [threading.Thread(target=produce) for _ in range(4)]
+        threads.append(threading.Thread(target=consume))
+        for th in threads:
+            th.start()
+        time.sleep(0.01)
+        q.close()  # producers AND the consumer still running
+        time.sleep(0.01)
+        stop.set()
+        for th in threads:
+            th.join()
+        # close cleared the token registry; post-close enqueues must not
+        # repopulate it (the no-op path) — this is the real state check,
+        # not the flag-shortcircuited len()/dequeue()
+        q.enqueue("late-1")
+        q.enqueue("late-2")
+        assert q._registry == {}
+        # __del__ reclaims the native queue + pending nodes without crashing
+        del q
