@@ -13,5 +13,7 @@ See BASELINE.json north star and SURVEY.md §7 step 2. Public surface:
     sys.tell(0, [1.0]); sys.run(100)
 """
 
-from .behavior import BatchedBehavior, Ctx, Emit, Inbox, behavior  # noqa: F401
+from .behavior import (BatchedBehavior, Ctx, Emit, Inbox, Mailbox,  # noqa: F401
+                       behavior)
 from .core import BatchedSystem  # noqa: F401
+from .step import StepCore  # noqa: F401

@@ -38,12 +38,54 @@ class Inbox(NamedTuple):
     count: jax.Array  # [] int32 number of messages delivered
 
 
+class Mailbox(NamedTuple):
+    """One actor's per-message mailbox for one step (slots mode): up to S
+    discrete messages in arrival order — the tensorized Envelope queue of
+    the reference (dispatch/Mailbox.scala:260-277). Slot i is older than
+    slot i+1; per-sender FIFO is guaranteed by stable (recipient, seq)
+    delivery (ops/segment.py deliver_slots)."""
+
+    types: jax.Array    # [S] int32 message-type tags
+    payload: jax.Array  # [S, P]
+    valid: jax.Array    # [S] bool
+    count: jax.Array    # [] int32 messages addressed this step (can be > S)
+    sum: jax.Array      # [P] exact sum over ALL addressed messages
+    max: jax.Array      # [P] exact max over ALL messages (zeros unless the
+                        #     system was built with need_max)
+
+    def fold(self, init_carry, fn):
+        """Process slots in FIFO order: fn(carry, mtype, payload) -> carry,
+        applied only to valid slots (lax.scan over S — the processMailbox
+        dequeue loop as a scan). Returns the final carry."""
+        def body(carry, slot):
+            t, pl, v = slot
+            new = fn(carry, t, pl)
+            return jax.tree.map(
+                lambda a, b: jnp.where(_bshape(v, a), a, b), new, carry), None
+        carry, _ = jax.lax.scan(body, init_carry,
+                                (self.types, self.payload, self.valid))
+        return carry
+
+    def reduce(self) -> "Inbox":
+        """Commutative view so reduce-kind behaviors run unmodified inside a
+        slots-mode system. Uses the delivery's EXACT full-inbox aggregation
+        (computed over all addressed messages, not just the S slot-resident
+        ones) — slot overflow never corrupts reduce-behavior state."""
+        return Inbox(sum=self.sum, max=self.max, count=self.count)
+
+
+def _bshape(cond, like):
+    """Broadcast a scalar bool against an arbitrary-rank carry leaf."""
+    return jnp.reshape(cond, (1,) * like.ndim) if like.ndim else cond
+
+
 class Emit(NamedTuple):
     """Up to K outgoing messages from one actor in one step."""
 
     dst: jax.Array      # [K] int32 recipient ids (global); -1 = none
     payload: jax.Array  # [K, P]
     valid: jax.Array    # [K] bool
+    type: Any = None    # [K] int32 message-type tags (None -> all zeros)
 
     @staticmethod
     def none(out_degree: int, payload_width: int, dtype=jnp.float32) -> "Emit":
@@ -51,11 +93,12 @@ class Emit(NamedTuple):
             dst=jnp.full((out_degree,), -1, dtype=jnp.int32),
             payload=jnp.zeros((out_degree, payload_width), dtype=dtype),
             valid=jnp.zeros((out_degree,), dtype=jnp.bool_),
+            type=jnp.zeros((out_degree,), dtype=jnp.int32),
         )
 
     @staticmethod
     def single(dst, payload, out_degree: int, payload_width: int,
-               when=True, dtype=jnp.float32) -> "Emit":
+               when=True, dtype=jnp.float32, mtype=0) -> "Emit":
         """One message in slot 0, rest empty. `when` may be a traced bool."""
         e = Emit.none(out_degree, payload_width, dtype)
         pl = jnp.asarray(payload, dtype=dtype).reshape(-1)
@@ -65,7 +108,14 @@ class Emit(NamedTuple):
             dst=e.dst.at[0].set(jnp.where(cond, jnp.asarray(dst, jnp.int32), -1)),
             payload=e.payload.at[0].set(pl),
             valid=e.valid.at[0].set(cond),
+            type=e.type.at[0].set(jnp.asarray(mtype, jnp.int32)),
         )
+
+    def with_type(self) -> "Emit":
+        """Normalize: a None type column becomes zeros (trace-time check)."""
+        if self.type is None:
+            return self._replace(type=jnp.zeros_like(self.dst))
+        return self
 
 
 class Ctx(NamedTuple):
@@ -80,15 +130,27 @@ class Ctx(NamedTuple):
 class BatchedBehavior:
     """The batched analogue of Behavior[T].
 
-    `receive` signature: (state: dict[str, Array-per-actor-slice], inbox: Inbox,
-    ctx: Ctx) -> (new_state, Emit). Runs only for actors whose `count > 0`
-    unless `always_on` (sources tick every step).
+    Two inbox kinds (`inbox` field):
+    - "reduce" (default): `receive(state_row, inbox: Inbox, ctx)` sees the
+      commutative (sum, max, count) aggregation — the fast path for
+      GNN-shaped/commutative actors (one segment reduction, no per-message
+      state on device).
+    - "slots": `receive(state_row, mailbox: Mailbox, ctx)` sees up to S
+      discrete (type, payload) messages in per-sender-FIFO arrival order —
+      full Akka mailbox semantics (dispatch/Mailbox.scala:260-277) for
+      non-commutative behaviors (order-dependent state machines, bank
+      accounts, FSMs).
+
+    A slots-mode system runs both kinds (reduce behaviors get
+    `mailbox.reduce()`); a reduce-mode system rejects slots behaviors.
+    Runs only for actors whose `count > 0` unless `always_on`.
     """
 
     name: str
     state_spec: Dict[str, Tuple[Tuple[int, ...], Any]]  # col -> (shape, dtype)
-    receive: Callable[[Dict[str, jax.Array], Inbox, Ctx], Tuple[Dict[str, jax.Array], Emit]]
+    receive: Callable[..., Tuple[Dict[str, jax.Array], Emit]]
     always_on: bool = False
+    inbox: str = "reduce"  # "reduce" | "slots"
 
     def init_state(self, n: int) -> Dict[str, jax.Array]:
         return {k: jnp.zeros((n,) + tuple(shape), dtype=dtype)
@@ -96,11 +158,11 @@ class BatchedBehavior:
 
 
 def behavior(name: str, state_spec: Dict[str, Tuple[Tuple[int, ...], Any]],
-             always_on: bool = False):
+             always_on: bool = False, inbox: str = "reduce"):
     """Decorator: @behavior("counter", {"count": ((), jnp.int32)})"""
 
     def deco(fn) -> BatchedBehavior:
         return BatchedBehavior(name=name, state_spec=state_spec, receive=fn,
-                               always_on=always_on)
+                               always_on=always_on, inbox=inbox)
 
     return deco

@@ -8,15 +8,19 @@ all_to_all exchange buffer inside the jitted step — messages ride ICI, never
 the host.
 
 Routing inside shard_map, per step:
-1. deliver the local inbox (segment-sum over local recipient ids),
+1. deliver the local inbox (StepCore: segment reduction, or stable-sorted
+   per-message mailbox slots — shared with BatchedSystem),
 2. run the vmapped behavior switch (global actor ids),
 3. bucket emitted messages by destination shard (stable sort → rank-in-group
    → scatter into a [D, C] exchange buffer; overflow drops are counted),
 4. `lax.all_to_all` the buffer — each shard receives its [D, C] slice, which
    becomes the next step's inbox (self-addressed chunks deliver locally).
 
-Per-pair capacity C defaults to lossless (all local emissions could target
-one shard). Static shapes throughout; the whole step is one jitted program.
+The bucketing sort is stable and each shard's send buffer is drained in slot
+order, so per-sender FIFO survives the exchange (messages from shard s to
+actor a arrive in emission order). Per-pair capacity C defaults to lossless
+(all local emissions could target one shard). Static shapes throughout; the
+whole step is one jitted program.
 """
 
 from __future__ import annotations
@@ -31,9 +35,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from ..ops.segment import Delivery, deliver
 from ..parallel.mesh import make_mesh
-from .behavior import BatchedBehavior, Ctx, Emit, Inbox
+from .behavior import BatchedBehavior
+from .step import StepCore
 
 
 class ShardedBatchedSystem:
@@ -42,7 +46,8 @@ class ShardedBatchedSystem:
                  payload_width: int = 4, out_degree: int = 1,
                  host_inbox_per_shard: int = 256,
                  remote_capacity_per_pair: Optional[int] = None,
-                 payload_dtype=jnp.float32, axis_name: str = "shards"):
+                 payload_dtype=jnp.float32, axis_name: str = "shards",
+                 mailbox_slots: int = 0):
         self.mesh = mesh if mesh is not None else make_mesh(n_devices, axis_name)
         self.axis = axis_name
         self.n_shards = self.mesh.shape[axis_name]
@@ -55,6 +60,9 @@ class ShardedBatchedSystem:
         self.out_degree = out_degree
         self.host_inbox = host_inbox_per_shard
         self.payload_dtype = payload_dtype
+        self.mailbox_slots = int(mailbox_slots)
+        if self.mailbox_slots == 0 and any(b.inbox == "slots" for b in behaviors):
+            self.mailbox_slots = max(2, out_degree)
         # lossless default: every local emission could target a single shard
         self.pair_cap = (remote_capacity_per_pair if remote_capacity_per_pair
                          else self.local_n * out_degree)
@@ -78,15 +86,24 @@ class ShardedBatchedSystem:
         self.m_local = self.n_shards * self.pair_cap + self.host_inbox
         m_global = self.m_local * self.n_shards
         self.inbox_dst = jax.device_put(jnp.full((m_global,), -1, jnp.int32), shard)
+        self.inbox_type = jax.device_put(jnp.zeros((m_global,), jnp.int32), shard)
         self.inbox_payload = jax.device_put(
             jnp.zeros((m_global, payload_width), payload_dtype), shard)
         self.inbox_valid = jax.device_put(jnp.zeros((m_global,), jnp.bool_), shard)
         self.dropped = jax.device_put(jnp.zeros((self.n_shards,), jnp.int32), shard)
+        self.mail_dropped = jax.device_put(
+            jnp.zeros((self.n_shards,), jnp.int32), shard)
 
         self._next_row = 0
         self._lock = threading.Lock()
-        self._host_staged: List[Tuple[int, np.ndarray]] = []
+        self._host_staged: List[Tuple[int, int, np.ndarray]] = []
 
+        self._core = StepCore(self.behaviors, n_local=self.local_n,
+                              payload_width=payload_width,
+                              out_degree=out_degree,
+                              payload_dtype=payload_dtype,
+                              slots=self.mailbox_slots,
+                              n_global=self.capacity)
         self._step_fn = self._build_step()
 
     # -------------------------------------------------------------- builders
@@ -95,55 +112,22 @@ class ShardedBatchedSystem:
         p_w, dtype = self.payload_width, self.payload_dtype
         pair_cap, m_local, axis = self.pair_cap, self.m_local, self.axis
         n_global = self.capacity
-        behaviors = self.behaviors
+        core = self._core
 
-        def wrap(b: BatchedBehavior):
-            def branch(state_row, inbox: Inbox, ctx: Ctx):
-                new_cols, emit = b.receive(dict(state_row), inbox, ctx)
-                merged = dict(state_row)
-                merged.update(new_cols)
-                active = (inbox.count > 0) | jnp.asarray(b.always_on)
-                merged = jax.tree.map(
-                    lambda new, old: jnp.where(
-                        jnp.reshape(active, tuple([1] * new.ndim)) if new.ndim else active,
-                        new, old),
-                    merged, dict(state_row))
-                return merged, Emit(dst=jnp.where(active, emit.dst, -1),
-                                    payload=emit.payload,
-                                    valid=emit.valid & active)
-            return branch
-
-        branches = [wrap(b) for b in behaviors]
-
-        def local_step(state, behavior_id, alive, inbox_dst, inbox_payload,
-                       inbox_valid, dropped, step_count):
+        def local_step(state, behavior_id, alive, inbox_dst, inbox_type,
+                       inbox_payload, inbox_valid, dropped, mail_dropped,
+                       step_count):
             # shapes here are per-shard blocks
             shard_idx = jax.lax.axis_index(axis)
             base = shard_idx * n_local
 
-            local_dst = inbox_dst - base  # global -> local
-            d: Delivery = deliver(local_dst, inbox_payload, inbox_valid, n_local)
-
-            ids = base + jnp.arange(n_local, dtype=jnp.int32)
-
-            def per_actor(state_row, b_id, sum_i, max_i, count_i, alive_i, gid):
-                inbox = Inbox(sum=sum_i, max=max_i, count=count_i)
-                ctx = Ctx(actor_id=gid, step=step_count,
-                          n_actors=jnp.asarray(n_global, jnp.int32))
-                new_state, emit = jax.lax.switch(b_id, branches, state_row, inbox, ctx)
-                new_state = jax.tree.map(
-                    lambda new, old: jnp.where(
-                        jnp.reshape(alive_i, tuple([1] * new.ndim)) if new.ndim else alive_i,
-                        new, old),
-                    new_state, state_row)
-                return new_state, Emit(dst=jnp.where(alive_i, emit.dst, -1),
-                                       payload=emit.payload,
-                                       valid=emit.valid & alive_i)
-
-            new_state, emits = jax.vmap(per_actor)(
-                state, behavior_id, d.sum, d.max, d.count, alive, ids)
+            new_state, emits, mdrop = core.run_local(
+                state, behavior_id, alive, inbox_dst, inbox_type,
+                inbox_payload, inbox_valid, step_count,
+                dst_offset=base, id_base=base)
 
             # ---- route: bucket by destination shard, exchange over ICI ----
+            slots_mode = self.mailbox_slots > 0
             out_dst = emits.dst.reshape(-1)                       # [n_local*k]
             out_payload = emits.payload.reshape(-1, p_w)
             out_valid = emits.valid.reshape(-1) & (out_dst >= 0) & (out_dst < n_global)
@@ -179,38 +163,55 @@ class ShardedBatchedSystem:
             recv_ok = jax.lax.all_to_all(
                 buf_ok.reshape(n_shards, pair_cap), axis, 0, 0, tiled=False).reshape(-1)
 
-            new_inbox_dst = jnp.concatenate(
-                [recv_dst, jnp.full((m_local - recv_dst.shape[0],), -1, jnp.int32)])
-            new_inbox_payload = jnp.concatenate(
-                [recv_pl, jnp.zeros((m_local - recv_pl.shape[0], p_w), dtype)])
-            new_inbox_valid = jnp.concatenate(
-                [recv_ok, jnp.zeros((m_local - recv_ok.shape[0],), jnp.bool_)])
+            # write received chunks in place over the donated inbox block;
+            # host rows (the tail) are cleared
+            r = recv_dst.shape[0]
+            new_inbox_dst = inbox_dst.at[:r].set(recv_dst).at[r:].set(-1)
+            if slots_mode:
+                # the type column rides the exchange only when somebody
+                # reads it — reduce-mode systems skip a whole collective
+                out_type = emits.type.reshape(-1)
+                type_sorted = out_type[order]
+                buf_type = jnp.zeros((n_shards * pair_cap + 1,), jnp.int32)
+                buf_type = buf_type.at[slot].set(
+                    jnp.where(in_cap, type_sorted, 0))[:-1]
+                recv_type = jax.lax.all_to_all(
+                    buf_type.reshape(n_shards, pair_cap), axis, 0, 0,
+                    tiled=False).reshape(-1)
+                new_inbox_type = inbox_type.at[:r].set(recv_type).at[r:].set(0)
+            else:
+                new_inbox_type = inbox_type  # never read in reduce mode
+            new_inbox_payload = inbox_payload.at[:r].set(recv_pl).at[r:].set(0)
+            new_inbox_valid = inbox_valid.at[:r].set(recv_ok).at[r:].set(False)
             new_dropped = dropped + n_dropped
+            new_mail_dropped = mail_dropped + mdrop
 
             return (new_state, behavior_id, alive, new_inbox_dst,
-                    new_inbox_payload, new_inbox_valid, new_dropped, step_count + 1)
+                    new_inbox_type, new_inbox_payload, new_inbox_valid,
+                    new_dropped, new_mail_dropped, step_count + 1)
 
         mesh = self.mesh
         state_specs = {k: P(axis) for k in self.state_spec}
         in_specs = (state_specs, P(axis), P(axis), P(axis), P(axis), P(axis),
-                    P(axis), P())
-        out_specs = (state_specs, P(axis), P(axis), P(axis), P(axis), P(axis),
-                     P(axis), P())
+                    P(axis), P(axis), P(axis), P())
+        out_specs = in_specs
 
         sharded = shard_map(local_step, mesh=mesh, in_specs=in_specs,
                             out_specs=out_specs, check_vma=False)
 
-        def multi_step(state, behavior_id, alive, inbox_dst, inbox_payload,
-                       inbox_valid, dropped, step_count, n_steps: int):
+        def multi_step(state, behavior_id, alive, inbox_dst, inbox_type,
+                       inbox_payload, inbox_valid, dropped, mail_dropped,
+                       step_count, n_steps: int):
             def body(carry, _):
                 return sharded(*carry), None
-            carry = (state, behavior_id, alive, inbox_dst, inbox_payload,
-                     inbox_valid, dropped, step_count)
+            carry = (state, behavior_id, alive, inbox_dst, inbox_type,
+                     inbox_payload, inbox_valid, dropped, mail_dropped,
+                     step_count)
             carry, _ = jax.lax.scan(body, carry, None, length=n_steps)
             return carry
 
-        return jax.jit(multi_step, static_argnums=(8,),
-                       donate_argnums=(0, 1, 2, 3, 4, 5, 6))
+        return jax.jit(multi_step, static_argnums=(10,),
+                       donate_argnums=tuple(range(9)))
 
     # ------------------------------------------------------------- lifecycle
     def spawn_block(self, behavior: BatchedBehavior | int, n: int,
@@ -230,12 +231,12 @@ class ShardedBatchedSystem:
                     jnp.asarray(value, dtype=self.state[col].dtype))
         return np.arange(start, start + n, dtype=np.int32)
 
-    def tell(self, dst: int, payload) -> None:
+    def tell(self, dst: int, payload, mtype: int = 0) -> None:
         pl = np.zeros(self.payload_width, dtype=jnp.dtype(self.payload_dtype))
         arr = np.asarray(payload).reshape(-1)
         pl[: arr.shape[0]] = arr
         with self._lock:
-            self._host_staged.append((int(dst), pl))
+            self._host_staged.append((int(dst), int(mtype), pl))
 
     def _flush_staged(self) -> None:
         with self._lock:
@@ -245,8 +246,8 @@ class ShardedBatchedSystem:
         # host slots live at the tail of each shard's inbox block; place each
         # message in its destination shard's host region
         per_shard_used: Dict[int, int] = {}
-        idxs, dsts, pls = [], [], []
-        for d, p in staged:
+        idxs, dsts, mts, pls = [], [], [], []
+        for d, t, p in staged:
             s = d // self.local_n
             u = per_shard_used.get(s, 0)
             if u >= self.host_inbox:
@@ -254,11 +255,13 @@ class ShardedBatchedSystem:
             per_shard_used[s] = u + 1
             idxs.append(s * self.m_local + self.n_shards * self.pair_cap + u)
             dsts.append(d)
+            mts.append(t)
             pls.append(p)
         if not idxs:
             return
         idx = jnp.asarray(idxs)
         self.inbox_dst = self.inbox_dst.at[idx].set(jnp.asarray(dsts, jnp.int32))
+        self.inbox_type = self.inbox_type.at[idx].set(jnp.asarray(mts, jnp.int32))
         self.inbox_payload = self.inbox_payload.at[idx].set(
             jnp.asarray(np.stack(pls), self.payload_dtype))
         self.inbox_valid = self.inbox_valid.at[idx].set(True)
@@ -267,10 +270,12 @@ class ShardedBatchedSystem:
     def run(self, n_steps: int = 1) -> None:
         self._flush_staged()
         (self.state, self.behavior_id, self.alive, self.inbox_dst,
-         self.inbox_payload, self.inbox_valid, self.dropped, self.step_count) = \
+         self.inbox_type, self.inbox_payload, self.inbox_valid, self.dropped,
+         self.mail_dropped, self.step_count) = \
             self._step_fn(self.state, self.behavior_id, self.alive,
-                          self.inbox_dst, self.inbox_payload, self.inbox_valid,
-                          self.dropped, self.step_count, n_steps)
+                          self.inbox_dst, self.inbox_type, self.inbox_payload,
+                          self.inbox_valid, self.dropped, self.mail_dropped,
+                          self.step_count, n_steps)
 
     step = run
 
@@ -283,6 +288,10 @@ class ShardedBatchedSystem:
     @property
     def total_dropped(self) -> int:
         return int(jnp.sum(self.dropped))
+
+    @property
+    def mailbox_overflow(self) -> int:
+        return int(jnp.sum(self.mail_dropped))
 
     def block_until_ready(self) -> None:
         # sync via host read of a non-donated output (see core.py note)

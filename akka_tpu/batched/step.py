@@ -1,0 +1,197 @@
+"""StepCore: the shared deliver→update kernel of the batched device runtime.
+
+One implementation backs both BatchedSystem (single device) and
+ShardedBatchedSystem (mesh): deliver the step's messages into per-actor
+inboxes, run every live actor's behavior as one vmapped lax.switch, and hand
+the emitted messages back to the caller (who rebuilds the local inbox or
+routes them across shards).
+
+This is the tensorized form of the reference's hot loop (SURVEY.md §3.2):
+Mailbox.processMailbox (dispatch/Mailbox.scala:260-277) + ActorCell.invoke
+(actor/ActorCell.scala:539-555) + the typed interpreter's tag switch
+(typed/Behavior.scala:244-278).
+
+Two delivery modes:
+- reduce: one segment reduction -> Inbox(sum, max, count). Commutative
+  fast path; supports StaticTopology compiled routing.
+- slots:  stable (recipient, seq) sort -> per-actor Mailbox of up to S
+  discrete (type, payload) messages in per-sender FIFO order — the full
+  Akka envelope-mailbox contract for non-commutative behaviors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.segment import (Delivery, SlotDelivery, deliver, deliver_slots,
+                           deliver_static)
+from .behavior import BatchedBehavior, Ctx, Emit, Inbox, Mailbox, _bshape
+
+
+class StepCore:
+    """Builds the jit-safe deliver+update function shared by both runtimes.
+
+    n_local: actors owned by this caller (rows in the state slabs it passes);
+    n_global: total actor-id space (== n_local on a single device).
+    slots=0 selects reduce mode; slots>0 selects per-message mailboxes of S
+    slots each.
+    """
+
+    def __init__(self, behaviors: Sequence[BatchedBehavior], n_local: int,
+                 payload_width: int, out_degree: int, payload_dtype,
+                 slots: int = 0, need_max: bool = False, topology=None,
+                 delivery: str = "sort", n_global: Optional[int] = None):
+        self.behaviors = list(behaviors)
+        self.n_local = int(n_local)
+        self.n_global = int(n_global if n_global is not None else n_local)
+        self.payload_width = int(payload_width)
+        self.out_degree = int(out_degree)
+        self.payload_dtype = payload_dtype
+        self.slots = int(slots)
+        self.need_max = need_max
+        self.topology = topology
+        self.delivery = delivery
+
+        if self.slots == 0:
+            bad = [b.name for b in self.behaviors if b.inbox == "slots"]
+            if bad:
+                raise ValueError(
+                    f"behaviors {bad} need per-message mailboxes: construct "
+                    f"the system with mailbox_slots > 0")
+        if self.slots > 0 and topology is not None:
+            raise ValueError("StaticTopology routing is a reduce-mode "
+                             "optimization; slots mode uses dynamic delivery")
+        self._branches = [self._wrap(b) for b in self.behaviors]
+        # which behaviors consume ordered slots: overflow past the slot cap
+        # is a real drop only for these — reduce-kind recipients get every
+        # message through the exact aggregation, so counting them would
+        # report phantom loss
+        self._slots_kind = jnp.asarray([b.inbox == "slots"
+                                        for b in self.behaviors], jnp.bool_)
+
+    # ---------------------------------------------------------------- wrap
+    def _wrap(self, b: BatchedBehavior):
+        """Uniform branch signature for lax.switch across inbox kinds, with
+        activity gating (idle actors skip: no mailbox -> no state change,
+        mirroring an empty mailbox never scheduling, Dispatcher.scala:120-143)
+        and alive gating applied by the caller's per_actor."""
+        slots_mode = self.slots > 0
+
+        def branch(state_row, delivered, ctx: Ctx):
+            if slots_mode:
+                mailbox: Mailbox = delivered
+                if b.inbox == "slots":
+                    new_cols, emit = b.receive(dict(state_row), mailbox, ctx)
+                else:
+                    new_cols, emit = b.receive(dict(state_row),
+                                               mailbox.reduce(), ctx)
+                count = mailbox.count
+            else:
+                inbox: Inbox = delivered
+                new_cols, emit = b.receive(dict(state_row), inbox, ctx)
+                count = inbox.count
+            emit = emit.with_type()
+            merged = dict(state_row)
+            merged.update(new_cols)
+            active = (count > 0) | jnp.asarray(b.always_on)
+            merged = jax.tree.map(
+                lambda new, old: jnp.where(_bshape(active, new), new, old),
+                merged, dict(state_row))
+            emit = Emit(dst=jnp.where(active, emit.dst, -1),
+                        payload=emit.payload,
+                        valid=emit.valid & active,
+                        type=emit.type)
+            return merged, emit
+
+        return branch
+
+    # ------------------------------------------------------------- deliver
+    def deliver(self, inbox_dst, inbox_type, inbox_payload, inbox_valid,
+                topo_arrays=(), dst_offset=None):
+        """Route this step's messages into per-actor inboxes. dst_offset
+        (traced scalar) maps global recipient ids to local rows (sharded
+        callers pass shard_base; single-device callers pass None)."""
+        n = self.n_local
+        dst = inbox_dst if dst_offset is None else inbox_dst - dst_offset
+        if self.slots > 0:
+            return deliver_slots(dst, inbox_type, inbox_payload, inbox_valid,
+                                 n, self.slots, self.need_max)
+        if self.topology is not None:
+            nk = self.n_local * self.out_degree
+            d = deliver_static(self.topology, topo_arrays,
+                               inbox_payload[:nk], inbox_valid[:nk],
+                               self.need_max)
+            if inbox_dst.shape[0] > nk:
+                hd = deliver(dst[nk:], inbox_payload[nk:], inbox_valid[nk:],
+                             n, self.need_max, mode="sort")
+                d = Delivery(sum=d.sum + hd.sum,
+                             max=jnp.maximum(d.max, hd.max),
+                             count=d.count + hd.count)
+            return d
+        return deliver(dst, inbox_payload, inbox_valid, n, self.need_max,
+                       mode=self.delivery)
+
+    # -------------------------------------------------------------- update
+    def update(self, state, behavior_id, alive, delivered, step_count,
+               id_base=0):
+        """Vmapped behavior switch over all local rows. Returns
+        (new_state, emits) with emits shaped [n_local, K(...)]. Dead rows
+        neither update nor emit."""
+        n = self.n_local
+        branches = self._branches
+        ids = jnp.asarray(id_base, jnp.int32) + jnp.arange(n, dtype=jnp.int32)
+        n_global = jnp.asarray(self.n_global, jnp.int32)
+
+        if self.slots > 0:
+            d: SlotDelivery = delivered
+            per_actor_inbox = (d.types, d.payload, d.valid, d.count, d.sum,
+                               d.max)
+
+            def make_inbox(t, pl, v, c, s, mx):
+                return Mailbox(types=t, payload=pl, valid=v, count=c, sum=s,
+                               max=mx)
+        else:
+            d = delivered
+            per_actor_inbox = (d.sum, d.max, d.count)
+
+            def make_inbox(s, mx, c):
+                return Inbox(sum=s, max=mx, count=c)
+
+        def per_actor(state_row, b_id, alive_i, gid, *inbox_parts):
+            inbox = make_inbox(*inbox_parts)
+            ctx = Ctx(actor_id=gid, step=step_count, n_actors=n_global)
+            new_state, emit = jax.lax.switch(b_id, branches, state_row,
+                                             inbox, ctx)
+            new_state = jax.tree.map(
+                lambda new, old: jnp.where(_bshape(alive_i, new), new, old),
+                new_state, state_row)
+            emit = Emit(dst=jnp.where(alive_i, emit.dst, -1),
+                        payload=emit.payload,
+                        valid=emit.valid & alive_i,
+                        type=emit.type)
+            return new_state, emit
+
+        return jax.vmap(per_actor)(state, behavior_id, alive, ids,
+                                   *per_actor_inbox)
+
+    def run_local(self, state, behavior_id, alive, inbox_dst, inbox_type,
+                  inbox_payload, inbox_valid, step_count, topo_arrays=(),
+                  dst_offset=None, id_base=0):
+        """deliver + update in one call. Returns (new_state, emits, dropped)
+        where dropped is this step's mailbox-overflow count (0 in reduce
+        mode — reductions never overflow)."""
+        d = self.deliver(inbox_dst, inbox_type, inbox_payload, inbox_valid,
+                         topo_arrays, dst_offset)
+        new_state, emits = self.update(state, behavior_id, alive, d,
+                                       step_count, id_base)
+        if self.slots > 0:
+            # per-recipient overflow, masked to slots-kind recipients
+            over = jnp.maximum(d.count - self.slots, 0)
+            dropped = jnp.sum(jnp.where(self._slots_kind[behavior_id],
+                                        over, 0)).astype(jnp.int32)
+        else:
+            dropped = jnp.asarray(0, jnp.int32)
+        return new_state, emits, dropped

@@ -19,13 +19,20 @@ from .queues import NativeMpscQueue, NativeWheelTimer
 
 
 class NativeMessageQueue(MessageQueue):
-    __slots__ = ("_q",)
+    __slots__ = ("_q", "_dead_letters")
 
     def __init__(self):
         self._q = NativeMpscQueue()
+        self._dead_letters: Optional[MessageQueue] = None
 
     def enqueue(self, receiver: Any, handle: Envelope) -> None:
-        self._q.enqueue(handle)
+        if not self._q.enqueue(handle):
+            # closed (actor stopped): redirect to dead letters, mirroring
+            # the reference's becomeClosed mailbox swap — late sends are
+            # visible on the EventStream, never silently lost
+            dl = self._dead_letters
+            if dl is not None:
+                dl.enqueue(receiver, handle)
 
     def dequeue(self) -> Optional[Envelope]:
         return self._q.dequeue()
@@ -35,10 +42,16 @@ class NativeMessageQueue(MessageQueue):
         return len(self._q)
 
     def clean_up(self, owner: Any, dead_letters: MessageQueue) -> None:
-        """On actor stop: drain to dead letters, then mark the native queue
-        closed so late tells take the safe no-op path. Memory is reclaimed
-        by NativeMpscQueue.__del__ once no producer can hold the handle."""
-        super().clean_up(owner, dead_letters)
+        """On actor stop: install the dead-letter sink for late tells, shut
+        the producer side, drain what's left to dead letters, then sweep
+        messages orphaned by racing producers — every message is either
+        delivered or dead-lettered, exactly once. Memory is reclaimed by
+        NativeMpscQueue.__del__ once no producer can hold the handle."""
+        self._dead_letters = dead_letters
+        self._q.close_producers()
+        super().clean_up(owner, dead_letters)  # drains visible nodes
+        for obj in self._q.drain_registry():
+            dead_letters.enqueue(owner, obj)
         self._q.close()
 
 

@@ -27,22 +27,31 @@ class NativeMpscQueue:
         if self._lib is None:
             raise RuntimeError("native library unavailable")
         self._h = self._lib.aq_mpsc_create()
-        self._closed = False
+        self._closed = False            # consumer side shut (full close)
+        self._closed_producers = False  # producer side shut (phase 1)
         self._tokens = itertools.count(1)
         self._registry: Dict[int, Any] = {}
         self._out = (ctypes.c_uint64 * 1)()
 
-    def enqueue(self, obj: Any) -> None:
-        if self._closed:
-            return  # closed (actor stopped): drop, mirrors dead-letter path
+    def enqueue(self, obj: Any) -> bool:
+        """Returns False when the queue is closed (actor stopped) and the
+        message was NOT accepted — the caller routes it to dead letters
+        (becomeClosed parity: late sends are redirected, never lost)."""
+        if self._closed_producers:
+            return False
         tok = next(self._tokens)
         self._registry[tok] = obj
         # safe vs concurrent close(): close only sets the closed flag (no
         # free, no drain — a drain would be a second consumer); memory is
         # freed in __del__, which cannot run while this frame holds a ref
         self._lib.aq_mpsc_enqueue(self._h, tok)
-        if self._closed:
-            self._registry.pop(tok, None)
+        if self._closed_producers:
+            # close raced us. If our token is still registered, pull it back
+            # and report rejection (caller dead-letters it). If it is gone,
+            # either the consumer delivered it or the close-time registry
+            # sweep (drain_registry) dead-lettered it — accepted either way.
+            return self._registry.pop(tok, None) is None
+        return True
 
     def dequeue(self) -> Optional[Any]:
         if self._closed:
@@ -58,16 +67,30 @@ class NativeMpscQueue:
             return 0
         return int(self._lib.aq_mpsc_count(self._h))
 
-    def close(self) -> None:
-        """Mark closed; late tells become safe no-ops. Nothing is freed or
-        drained here: a drain would race the consumer's in-flight dequeue
-        (two consumers on a single-consumer queue), and freeing would race
-        producers mid-enqueue (ADVICE r1). Reclamation happens in __del__
-        when no reference — hence no in-flight caller — remains."""
-        if not self._closed:
-            self._closed = True
+    def close_producers(self) -> None:
+        """Phase 1 of shutdown: reject new enqueues; the consumer can still
+        drain. Nothing is freed (producers may be mid-enqueue — ADVICE r1)."""
+        if not self._closed_producers:
+            self._closed_producers = True
             self._lib.aq_mpsc_close(self._h)
-            self._registry.clear()
+
+    def drain_registry(self) -> list:
+        """Swap out the token registry and return the orphaned messages —
+        tokens enqueued by racing producers that the consumer never drained.
+        Call after close_producers + a full dequeue drain; the caller routes
+        these to dead letters (exactly-once: a producer whose token survives
+        here sees pop miss and reports 'accepted')."""
+        old, self._registry = self._registry, {}
+        return list(old.values())
+
+    def close(self) -> None:
+        """Full close: producers rejected, consumer reads nothing further.
+        No free, no drain, and no registry clear here (clearing would race a
+        producer's post-enqueue pop-back check into reporting 'accepted' for
+        a message nobody swept); in-flight racers pop their own tokens, and
+        whatever remains is reclaimed with the object in __del__."""
+        self.close_producers()
+        self._closed = True
 
     def __del__(self):  # true reclamation: no refs => no in-flight producers
         try:

@@ -94,6 +94,93 @@ def _deliver_sorted(dst, payload, valid, n_actors: int, need_max: bool) -> Deliv
     return Delivery(sum=sums, max=maxs, count=counts)
 
 
+class SlotDelivery(NamedTuple):
+    """Per-message mailbox delivery: each actor's first `slots` messages this
+    step, in arrival order (per-sender FIFO), plus the EXACT commutative
+    aggregation over ALL addressed messages (not just the S kept) so
+    reduce-kind behaviors coexisting in a slots-mode system lose nothing."""
+
+    types: jax.Array    # [N, S] int32 message-type tags (slot invalid -> 0)
+    payload: jax.Array  # [N, S, P]
+    valid: jax.Array    # [N, S] bool
+    count: jax.Array    # [N] int32 messages addressed (may exceed S)
+    sum: jax.Array      # [N, P] segment-sum over ALL messages (exact)
+    max: jax.Array      # [N, P] segment-max over ALL messages (zeros unless
+                        #        need_max)
+    dropped: jax.Array  # [] int32 total mailbox-overflow drops this step
+
+
+def deliver_slots(dst: jax.Array, mtype: jax.Array, payload: jax.Array,
+                  valid: jax.Array, n_actors: int, slots: int,
+                  need_max: bool = False) -> SlotDelivery:
+    """Ordered per-message delivery into per-actor mailbox slots.
+
+    The TPU-native form of the reference's discrete-envelope mailbox
+    (dispatch/Mailbox.scala:260-277 processMailbox dequeues one Envelope at a
+    time in FIFO order): a stable sort on recipient id — with arrival index as
+    the implicit tiebreak — lines messages up in (recipient, seq) order, and a
+    rank-in-segment scatter places each actor's first `slots` messages into its
+    mailbox rows. Per-sender FIFO holds because a sender's emissions occupy
+    increasing flat inbox indices and the sort is stable (SURVEY.md §7 hard
+    parts: ordering under scatter delivery).
+
+    dst: [M] int32; mtype: [M] int32; payload: [M, P]; valid: [M] bool.
+    Arrival order IS the index order of the inputs. Messages beyond `slots`
+    for one actor are dropped and counted (bounded-mailbox overflow,
+    dispatch/Mailbox.scala:415-443 — surface via dead letters host-side).
+    """
+    m, p = payload.shape
+    ok = valid & (dst >= 0) & (dst < n_actors)
+    key = jnp.where(ok, dst, n_actors).astype(jnp.int32)
+    # stable argsort by recipient; equal keys keep arrival order
+    order = jnp.argsort(key, stable=True)
+    skey = key[order]
+    bounds = jnp.searchsorted(skey, jnp.arange(n_actors + 1, dtype=jnp.int32))
+    group_start = bounds[jnp.minimum(skey, n_actors)]
+    rank = jnp.arange(m, dtype=jnp.int32) - group_start.astype(jnp.int32)
+    live = skey < n_actors
+    in_cap = live & (rank < slots)
+    slot = jnp.where(in_cap, skey * slots + rank, n_actors * slots)
+
+    buf_t = jnp.zeros((n_actors * slots + 1,), jnp.int32)
+    buf_p = jnp.zeros((n_actors * slots + 1, p), payload.dtype)
+    buf_v = jnp.zeros((n_actors * slots + 1,), jnp.bool_)
+    st = mtype[order]
+    sp = payload[order]
+    buf_t = buf_t.at[slot].set(jnp.where(in_cap, st, 0))
+    buf_p = buf_p.at[slot].set(jnp.where(in_cap[:, None], sp, 0))
+    buf_v = buf_v.at[slot].set(in_cap)
+
+    counts = (bounds[1:] - bounds[:-1]).astype(jnp.int32)
+    dropped = jnp.sum((live & ~in_cap).astype(jnp.int32))
+
+    # exact full-inbox aggregation on the already-sorted data (cumsum
+    # differences at segment boundaries — the same trick as _deliver_sorted),
+    # so Mailbox.reduce() sees every message even past the slot cap
+    sp_masked = jnp.where(live[:, None], sp, 0)
+    csum = jnp.concatenate([jnp.zeros((1, p), sp_masked.dtype),
+                            jnp.cumsum(sp_masked, axis=0)], axis=0)
+    sums = (csum[bounds[1:]] - csum[bounds[:-1]]).astype(payload.dtype)
+    if need_max:
+        neg_inf = _neg_inf(payload.dtype)
+        maxs = jax.ops.segment_max(
+            jnp.where(live[:, None], sp, neg_inf), skey,
+            num_segments=n_actors + 1)[:n_actors]
+        maxs = jnp.where((counts > 0)[:, None], maxs, 0)
+    else:
+        maxs = jnp.zeros((n_actors, p), payload.dtype)
+
+    return SlotDelivery(
+        types=buf_t[:-1].reshape(n_actors, slots),
+        payload=buf_p[:-1].reshape(n_actors, slots, p),
+        valid=buf_v[:-1].reshape(n_actors, slots),
+        count=counts,
+        sum=sums,
+        max=maxs,
+        dropped=dropped,
+    )
+
+
 class StaticTopology:
     """Precompiled communication graph: delivery with NO runtime sort/scatter.
 

@@ -248,11 +248,42 @@ def test_mpsc_close_races_with_producers_and_consumer():
         stop.set()
         for th in threads:
             th.join()
-        # close cleared the token registry; post-close enqueues must not
-        # repopulate it (the no-op path) — this is the real state check,
-        # not the flag-shortcircuited len()/dequeue()
-        q.enqueue("late-1")
-        q.enqueue("late-2")
-        assert q._registry == {}
+        # post-close enqueues are rejected (caller dead-letters) and leave
+        # no lasting registry entries — this is the real state check, not
+        # the flag-shortcircuited len()/dequeue()
+        before = len(q._registry)
+        assert q.enqueue("late-1") is False
+        assert q.enqueue("late-2") is False
+        assert len(q._registry) == before
         # __del__ reclaims the native queue + pending nodes without crashing
         del q
+
+
+def test_late_tell_to_stopped_native_mailbox_goes_to_dead_letters():
+    """becomeClosed parity: a tell to a stopped actor with a native mailbox
+    must surface as a DeadLetter on the event stream, never vanish."""
+    from akka_tpu.actor.messages import DeadLetter, PoisonPill
+    system = ActorSystem.create("native-dl", {
+        "akka": {"stdout-loglevel": "OFF", "log-dead-letters": 0,
+                 "actor": {"native-mailboxes": True}}})
+    try:
+        probe = TestProbe(system)
+        system.event_stream.subscribe(probe.ref, DeadLetter)
+
+        class Sink(Actor):
+            def receive(self, message):
+                pass
+
+        ref = system.actor_of(Props(factory=Sink, cls=Sink,
+                                    mailbox="native-unbounded"), "sink")
+        stop_probe = TestProbe(system)
+        stop_probe.watch(ref)
+        ref.tell(PoisonPill, None)
+        stop_probe.expect_terminated(ref, 5.0)
+        ref.tell("too-late", probe.ref)
+        dl = probe.receive_one(5.0)
+        assert isinstance(dl, DeadLetter)
+        assert dl.message == "too-late"
+    finally:
+        system.terminate()
+        system.await_termination(10.0)
