@@ -18,6 +18,9 @@ class Props:
     dispatcher: Optional[str] = None           # dispatcher config id
     mailbox: Optional[Any] = None              # mailbox name or MailboxType
     router_config: Optional[Any] = None        # RouterConfig (akka_tpu.routing)
+    device: Optional[Any] = None               # DeviceSpec: rows in the
+                                               # tpu-batched runtime instead
+                                               # of a host cell (bridge.py)
 
     @staticmethod
     def create(cls: type, *args, **kwargs) -> "Props":

@@ -83,6 +83,23 @@ class LocalActorRefProvider:
     # -- actorOf (reference: ActorRefProvider.actorOf :116) ------------------
     def actor_of(self, system, props: Props, supervisor: InternalActorRef,
                  path: ActorPath) -> InternalActorRef:
+        if props.device is not None:
+            # device-resident actor: rows in the tpu-batched runtime behind
+            # an ordinary ref — no cell, no host mailbox (the Dispatchers
+            # seam selects the backend, dispatch/Dispatchers.scala:121-259)
+            from ..dispatch.batched import TpuBatchedDispatcher
+            from ..batched.bridge import DeviceActorRef, DeviceBlockRef
+            did = props.dispatcher or system.dispatchers.DEFAULT_DISPATCHER_ID
+            disp = system.dispatchers.lookup(did)
+            if not isinstance(disp, TpuBatchedDispatcher):
+                disp = system.dispatchers.lookup("akka.actor.tpu-dispatcher")
+            spec = props.device
+            handle = disp.handle(system)
+            rows = handle.spawn(spec.behavior, spec.n, spec.init_state)
+            if spec.n == 1:
+                return DeviceActorRef(system, handle, int(rows[0]), path,
+                                      spec.codec)
+            return DeviceBlockRef(system, handle, rows, path, spec.codec)
         if props.router_config is not None:
             from ..routing.routed_cell import RoutedActorRef
             ref = RoutedActorRef(system, props, props.dispatcher, supervisor, path)

@@ -15,5 +15,8 @@ See BASELINE.json north star and SURVEY.md §7 step 2. Public surface:
 
 from .behavior import (BatchedBehavior, Ctx, Emit, Inbox, Mailbox,  # noqa: F401
                        behavior)
+from .bridge import (BatchedRuntimeHandle, DefaultCodec,  # noqa: F401
+                     DeviceActorRef, DeviceBlockRef, MessageCodec,
+                     device_props, get_handle, reply_dst)
 from .core import BatchedSystem  # noqa: F401
 from .step import StepCore  # noqa: F401

@@ -136,6 +136,18 @@ class BatchedSystem:
             except Exception:  # noqa: BLE001 — no compiler / odd dtype
                 self._stager = None
 
+        # shape-stable flush: reusable host-side pad buffers + ONE jitted
+        # update program (a per-batch-size .at[idx].set would recompile for
+        # every distinct staged count — seconds per compile on a tunneled
+        # backend)
+        self._flush_dst = np.full((self.host_inbox,), -1, np.int32)
+        self._flush_type = np.zeros((self.host_inbox,), np.int32)
+        self._flush_payload = np.zeros(
+            (self.host_inbox, self.payload_width), self._np_payload_dtype)
+        self._flush_valid = np.zeros((self.host_inbox,), np.bool_)
+        self._flush_jit = jax.jit(self._flush_impl,
+                                  donate_argnums=(0, 1, 2, 3))
+
         self._core = StepCore(self.behaviors, n_local=self.capacity,
                               payload_width=self.payload_width,
                               out_degree=self.out_degree,
@@ -245,21 +257,43 @@ class BatchedSystem:
         self.inbox_payload = self.inbox_payload.at[:k].set(payload)
         self.inbox_valid = self.inbox_valid.at[:k].set(True)
 
+    def _flush_impl(self, inbox_dst, inbox_type, inbox_payload, inbox_valid,
+                    dsts, mts, pls, valid):
+        """One static-shape program: overwrite the host region of the inbox.
+        [host_inbox]-shaped args regardless of how many tells are staged."""
+        base = self.capacity * self.out_degree
+        upd = jax.lax.dynamic_update_slice
+        return (upd(inbox_dst, dsts, (base,)),
+                upd(inbox_type, mts, (base,)),
+                upd(inbox_payload, pls, (base, 0)),
+                upd(inbox_valid, valid, (base,)))
+
+    def _run_flush(self, k: int) -> None:
+        """Push the filled pad buffers (first k rows meaningful) to device."""
+        self._flush_valid[:k] = True
+        self._flush_valid[k:] = False
+        self._flush_dst[k:] = -1
+        (self.inbox_dst, self.inbox_type, self.inbox_payload,
+         self.inbox_valid) = self._flush_jit(
+            self.inbox_dst, self.inbox_type, self.inbox_payload,
+            self.inbox_valid,
+            jnp.asarray(self._flush_dst), jnp.asarray(self._flush_type),
+            jnp.asarray(self._flush_payload, self.payload_dtype),
+            jnp.asarray(self._flush_valid))
+
     def _flush_staged(self) -> None:
         if self._stager is not None:
             dsts_np, rows_np = self._stager.drain()
-            if dsts_np.shape[0] == 0:
+            k = dsts_np.shape[0]
+            if k == 0:
                 return
-            base = self.capacity * self.out_degree
-            idx = jnp.arange(base, base + dsts_np.shape[0])
-            self.inbox_dst = self.inbox_dst.at[idx].set(jnp.asarray(dsts_np))
+            self._flush_dst[:k] = dsts_np
             if self.mailbox_slots > 0:
-                self.inbox_type = self.inbox_type.at[idx].set(
-                    jnp.asarray(self._unpack_type(rows_np[:, 0])))
-                rows_np = rows_np[:, 1:]
-            self.inbox_payload = self.inbox_payload.at[idx].set(
-                jnp.asarray(rows_np, self.payload_dtype))
-            self.inbox_valid = self.inbox_valid.at[idx].set(True)
+                self._flush_type[:k] = self._unpack_type(rows_np[:, 0])
+                self._flush_payload[:k] = rows_np[:, 1:]
+            else:
+                self._flush_payload[:k] = rows_np
+            self._run_flush(k)
             return
         with self._lock:
             staged, self._host_staged = self._host_staged, []
@@ -272,15 +306,11 @@ class BatchedSystem:
             if self.on_dropped is not None:
                 self.on_dropped(n_drop)
             staged = staged[: self.host_inbox]
-        base = self.capacity * self.out_degree
-        idx = jnp.arange(base, base + len(staged))
-        dsts = jnp.asarray([d for d, _, _ in staged], dtype=jnp.int32)
-        mts = jnp.asarray([t for _, t, _ in staged], dtype=jnp.int32)
-        pls = jnp.asarray(np.stack([p for _, _, p in staged]), dtype=self.payload_dtype)
-        self.inbox_dst = self.inbox_dst.at[idx].set(dsts)
-        self.inbox_type = self.inbox_type.at[idx].set(mts)
-        self.inbox_payload = self.inbox_payload.at[idx].set(pls)
-        self.inbox_valid = self.inbox_valid.at[idx].set(True)
+        k = len(staged)
+        self._flush_dst[:k] = [d for d, _, _ in staged]
+        self._flush_type[:k] = [t for _, t, _ in staged]
+        self._flush_payload[:k] = np.stack([p for _, _, p in staged])
+        self._run_flush(k)
 
     # ------------------------------------------------------------------ step
     def _step_impl(self, state, behavior_id, alive, inbox_dst, inbox_type,
@@ -341,6 +371,28 @@ class BatchedSystem:
         self._flush_staged()
         self._set_carry(self._run_jit(*self._carry(), n_steps,
                                       self._topo_arrays))
+
+    def warmup(self) -> None:
+        """Execute the step AND the flush once on throwaway zero-filled
+        buffers so the REAL first step — and any ask waiting on it — doesn't
+        absorb the cold-TPU XLA compile. A true execution (not
+        lower().compile()) is required: some backends (axon tunnel) miss the
+        dispatch cache for AOT-compiled donated signatures. The clones are
+        donated and freed; our live carry is untouched."""
+        clone = jax.tree.map(jnp.zeros_like, self._carry())
+        out = self._step_jit(*clone, self._topo_arrays)
+        jax.tree.map(lambda a: a.delete() if hasattr(a, "delete") else None,
+                     out)
+        m = self.inbox_dst.shape[0]
+        out = self._flush_jit(
+            jnp.zeros((m,), jnp.int32), jnp.zeros((m,), jnp.int32),
+            jnp.zeros((m, self.payload_width), self.payload_dtype),
+            jnp.zeros((m,), jnp.bool_),
+            jnp.asarray(self._flush_dst), jnp.asarray(self._flush_type),
+            jnp.asarray(self._flush_payload, self.payload_dtype),
+            jnp.asarray(self._flush_valid))
+        jax.tree.map(lambda a: a.delete() if hasattr(a, "delete") else None,
+                     out)
 
     def block_until_ready(self) -> None:
         # sync via a host read of a non-donated output: on some platforms

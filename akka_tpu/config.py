@@ -171,8 +171,12 @@ def reference_config() -> Config:
                     # SoA actor slabs stepped on-device; see akka_tpu/dispatch/batched.py
                     "type": "tpu-batched",
                     "capacity": 1 << 20,
-                    "inbox-capacity": 1 << 20,
                     "payload-width": 8,
+                    "out-degree": 1,
+                    "host-inbox": 4096,
+                    "mailbox-slots": 0,     # >0 = per-message ordered mailboxes
+                    "promise-rows": 256,    # ask() promise slots
+                    "auto-step-interval": "1ms",
                     "mesh-axes": {},
                 },
                 "default-mailbox": {

@@ -2,15 +2,16 @@
 
 Reference parity: the MessageDispatcherConfigurator / Dispatchers extension
 point (dispatch/Dispatchers.scala:235-259, registerConfigurator :184-185)
-gates the backend, so `akka.actor.default-dispatcher.type = tpu-batched` (or a
-dedicated `akka.actor.tpu-dispatcher` id) selects this dispatcher.
+gates the backend, so `akka.actor.default-dispatcher.type = tpu-batched` (or
+the dedicated `akka.actor.tpu-dispatcher` id) selects this dispatcher.
 
 Semantics: ordinary Python actors attached to this dispatcher still execute on
 a host thread pool (they are the control plane / IO edge), but the dispatcher
-owns a device-resident BatchedSystem; actors whose Props carry a
-BatchedBehavior are laid out as rows in the SoA slabs and stepped on-device.
-`BatchedRuntimeHandle.tell` bridges host refs into the device inbox (the
-slow-lane equivalent of Artery's large-message lane)."""
+owns a BatchedRuntimeHandle (akka_tpu/batched/bridge.py); actors whose Props
+carry a DeviceSpec are laid out as rows in the SoA slabs and stepped
+on-device, with `ref.tell` staged through the native stager and `ask`
+completed via promise rows — the full ActorRef.! → receive stack of
+SURVEY.md §3.2 replaced by one jitted step."""
 
 from __future__ import annotations
 
@@ -21,39 +22,56 @@ from .dispatcher import Dispatcher, DispatcherConfigurator
 
 
 class TpuBatchedDispatcher(Dispatcher):
-    """Host-facing dispatcher + owner of the device BatchedSystem."""
+    """Host-facing dispatcher + owner of the device runtime handle."""
 
     def __init__(self, dispatchers, id: str, config):
         super().__init__(dispatchers, id,
                          throughput=config.get_int("throughput", 64),
                          shutdown_timeout=config.get_duration("shutdown-timeout", "1s"))
         self._config = config
-        self._runtime = None
+        self._handle = None
         self._runtime_lock = threading.Lock()
 
-    def runtime(self, behaviors=None, **overrides):
-        """Get (or lazily build) the BatchedSystem for this dispatcher.
-        First caller supplies the behavior list; later callers share it."""
+    def handle(self, system=None, **overrides):
+        """Get (or lazily build) the BatchedRuntimeHandle."""
         with self._runtime_lock:
-            if self._runtime is None:
-                if behaviors is None:
-                    raise ValueError(
-                        "tpu-batched runtime not initialized: first call must "
-                        "pass behaviors=[BatchedBehavior, ...]")
-                from ..batched.core import BatchedSystem
+            if self._handle is None:
+                from ..batched.bridge import BatchedRuntimeHandle
                 c = self._config
-                self._runtime = BatchedSystem(
+                self._handle = BatchedRuntimeHandle(
                     capacity=overrides.get("capacity", c.get_int("capacity", 1 << 20)),
-                    behaviors=behaviors,
-                    payload_width=overrides.get("payload_width", c.get_int("payload-width", 8)),
-                    out_degree=overrides.get("out_degree", c.get_int("out-degree", 1)),
-                    host_inbox=overrides.get("host_inbox", c.get_int("host-inbox", 1024)),
+                    payload_width=overrides.get(
+                        "payload_width", c.get_int("payload-width", 8)),
+                    out_degree=overrides.get(
+                        "out_degree", c.get_int("out-degree", 1)),
+                    host_inbox=overrides.get(
+                        "host_inbox", c.get_int("host-inbox", 4096)),
+                    mailbox_slots=overrides.get(
+                        "mailbox_slots", c.get_int("mailbox-slots", 0)),
+                    promise_rows=overrides.get(
+                        "promise_rows", c.get_int("promise-rows", 256)),
+                    auto_step_interval=c.get_duration(
+                        "auto-step-interval", "1ms"),
+                    event_stream=getattr(system, "event_stream", None),
                 )
-            return self._runtime
+            return self._handle
+
+    def runtime(self, behaviors=None, **overrides):
+        """Back-compat: the raw BatchedSystem (builds the handle; registers
+        any passed behaviors)."""
+        h = self.handle(**overrides)
+        for b in behaviors or ():
+            h._behavior_index(b)
+        return h.runtime
 
     @property
     def has_runtime(self) -> bool:
-        return self._runtime is not None
+        return self._handle is not None and self._handle._runtime is not None
+
+    def shutdown(self) -> None:
+        if self._handle is not None:
+            self._handle.shutdown()
+        super().shutdown()
 
 
 class TpuBatchedDispatcherConfigurator(DispatcherConfigurator):

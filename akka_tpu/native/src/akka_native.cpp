@@ -238,6 +238,7 @@ struct Stager {
     std::atomic<int64_t> cursor;      // monotonic reservation counter
     std::atomic<int64_t> committed;   // slots fully written
     std::atomic<int64_t> pending;     // producers between reserve and commit
+    std::atomic<bool> draining;       // consumer mid-drain (producers wait)
     int32_t* dst;
     uint8_t* payload;
     std::atomic<int64_t> dropped;
@@ -250,31 +251,45 @@ void* aq_stager_create(int64_t capacity, int64_t payload_bytes) {
     s->cursor.store(0);
     s->committed.store(0);
     s->pending.store(0);
-    s->dropped.store(0);
+    s->draining.store(false);
     s->dst = new int32_t[capacity];
     s->payload = new uint8_t[capacity * payload_bytes];
+    s->dropped.store(0);
     return s;
 }
 
 // thread-safe: reserve with one fetch_add, memcpy, then commit. All-or-
-// nothing per batch (a batch that would cross the end is dropped whole —
-// bounded-mailbox overflow semantics, cursor stays monotonic until drain).
+// nothing per batch. A batch colliding with an in-flight drain WAITS for
+// the drain and retries — only a genuinely full buffer drops (bounded-
+// mailbox overflow semantics); a concurrent flush must never lose tells.
 int64_t aq_stager_stage(void* h, int64_t k, const int32_t* dsts,
                         const uint8_t* payloads) {
     auto* s = static_cast<Stager*>(h);
-    s->pending.fetch_add(1, std::memory_order_acq_rel);
-    int64_t start = s->cursor.fetch_add(k, std::memory_order_acq_rel);
-    if (start + k > s->capacity) {
-        s->dropped.fetch_add(k, std::memory_order_relaxed);
+    for (int attempt = 0; attempt < 1 << 16; ++attempt) {
+        if (s->draining.load(std::memory_order_acquire)) {
+            std::this_thread::yield();
+            continue;
+        }
+        s->pending.fetch_add(1, std::memory_order_acq_rel);
+        int64_t start = s->cursor.fetch_add(k, std::memory_order_acq_rel);
+        if (start + k <= s->capacity) {
+            std::memcpy(s->dst + start, dsts, k * sizeof(int32_t));
+            std::memcpy(s->payload + start * s->payload_bytes, payloads,
+                        k * s->payload_bytes);
+            s->committed.fetch_add(k, std::memory_order_acq_rel);
+            s->pending.fetch_sub(1, std::memory_order_acq_rel);
+            return k;
+        }
         s->pending.fetch_sub(1, std::memory_order_acq_rel);
-        return 0;
+        if (!s->draining.load(std::memory_order_acquire)) {
+            // not a drain fence: the buffer is genuinely full
+            s->dropped.fetch_add(k, std::memory_order_relaxed);
+            return 0;
+        }
+        std::this_thread::yield();  // fenced by the drain: wait and retry
     }
-    std::memcpy(s->dst + start, dsts, k * sizeof(int32_t));
-    std::memcpy(s->payload + start * s->payload_bytes, payloads,
-                k * s->payload_bytes);
-    s->committed.fetch_add(k, std::memory_order_acq_rel);
-    s->pending.fetch_sub(1, std::memory_order_acq_rel);
-    return k;
+    s->dropped.fetch_add(k, std::memory_order_relaxed);
+    return 0;
 }
 
 int64_t aq_stager_count(void* h) {
@@ -292,7 +307,9 @@ int64_t aq_stager_dropped(void* h) {
 // is zeroed BEFORE the cursor so a post-reset stage can never be lost.
 int64_t aq_stager_drain(void* h, int32_t* dst_out, uint8_t* payload_out) {
     auto* s = static_cast<Stager*>(h);
-    // fence off new successful stages for the duration of the drain
+    // flag first (late producers park), then fence the cursor so producers
+    // that already passed the flag check fail their reservation and retry
+    s->draining.store(true, std::memory_order_release);
     s->cursor.fetch_add(s->capacity + 1, std::memory_order_acq_rel);
     while (s->pending.load(std::memory_order_acquire) != 0)
         std::this_thread::yield();
@@ -301,6 +318,7 @@ int64_t aq_stager_drain(void* h, int32_t* dst_out, uint8_t* payload_out) {
     std::memcpy(payload_out, s->payload, n * s->payload_bytes);
     s->committed.store(0, std::memory_order_release);
     s->cursor.store(0, std::memory_order_release);
+    s->draining.store(false, std::memory_order_release);
     return n;
 }
 

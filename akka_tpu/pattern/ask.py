@@ -87,6 +87,23 @@ def ask(target: ActorRef, message: Any, timeout: float = 5.0, system=None) -> Fu
     """Send `message` to `target` with a promise ref as sender; returns a
     concurrent.futures.Future of the first reply. `message` may also be a
     callable ref -> message for typed-style ask."""
+    import sys
+    bridge = sys.modules.get("akka_tpu.batched.bridge")
+    if bridge is not None:
+        # only consult the device path if the batched runtime is actually
+        # loaded — host-only systems never pay the jax import here
+        if isinstance(target, bridge.DeviceActorRef):
+            # device actors complete asks via promise rows read back after
+            # a step (the PromiseActorRef analogue lives in HBM)
+            if callable(message) and not isinstance(message, type):
+                raise TypeError(
+                    "callable (typed-style) ask messages are not supported "
+                    "for device actors; encode the reply-to via the codec")
+            return target.ask(message, timeout)
+        if isinstance(target, bridge.DeviceBlockRef):
+            raise TypeError(
+                "ask() on a DeviceBlockRef is ambiguous (which row would "
+                "reply?); ask a single actor via block[i]")
     if system is None:
         system = getattr(target, "_system", None) or getattr(getattr(target, "cell", None), "system", None)
     if system is None:

@@ -1,0 +1,234 @@
+"""The tpu-batched dispatcher bridge: ActorRef.tell -> device rows (VERDICT
+r1 item 2).
+
+Covers the reference seam being replaced: Dispatchers type selection
+(dispatch/Dispatchers.scala:121-259), the tell hot path (SURVEY.md §3.2) and
+ask via promise refs (pattern/AskSupport.scala:476) — all against the device
+runtime through the PUBLIC ActorSystem API.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from akka_tpu import ActorSystem
+from akka_tpu.batched import (DeviceActorRef, DeviceBlockRef, Emit, Mailbox,
+                              behavior, device_props, get_handle, reply_dst)
+from akka_tpu.pattern.ask import ask_sync
+
+F32, I32 = jnp.float32, jnp.int32
+
+ADD, GET = 0, 1
+
+CFG = {"akka": {"stdout-loglevel": "OFF", "log-dead-letters": 0,
+                "actor": {"tpu-dispatcher": {
+                    "capacity": 1 << 12, "payload-width": 4,
+                    "mailbox-slots": 4, "host-inbox": 8192,
+                    "promise-rows": 32}}}}
+
+
+@behavior("counter", {"count": ((), F32)}, inbox="slots")
+def counter(state, mailbox: Mailbox, ctx):
+    def apply(carry, t, pl):
+        cnt, rdst = carry
+        return (jnp.where(t == ADD, cnt + pl[0], cnt),
+                jnp.where(t == GET, reply_dst(pl), rdst))
+
+    cnt, rdst = mailbox.fold((state["count"], jnp.asarray(-1, I32)), apply)
+    return ({"count": cnt},
+            Emit.single(rdst, cnt, 1, 4, when=rdst >= 0))
+
+
+def make_system(name):
+    return ActorSystem.create(name, CFG)
+
+
+def test_device_actor_tell_and_read():
+    system = make_system("bridge-tell")
+    try:
+        ref = system.actor_of(device_props(counter), "c1")
+        assert isinstance(ref, DeviceActorRef)
+        assert ref.path.name == "c1"
+        for x in (1.0, 2.0, 3.5):
+            ref.tell((ADD, [x]))
+        h = get_handle(system)
+        h.step()
+        assert ref.read_state("count") == 6.5
+    finally:
+        system.terminate()
+        system.await_termination(10.0)
+
+
+def test_device_ask_roundtrip():
+    """ask completes via a promise row the behavior replies to — the
+    device-resident PromiseActorRef."""
+    system = make_system("bridge-ask")
+    try:
+        ref = system.actor_of(device_props(counter), "c2")
+        ref.tell((ADD, [10.0]))
+        ref.tell((ADD, [5.0]))
+        # the auto-pump drives steps; no manual stepping
+        reply = ask_sync(ref, (GET, [0.0]), timeout=10.0)
+        assert reply[0] == 15.0
+    finally:
+        system.terminate()
+        system.await_termination(10.0)
+
+
+def test_device_ping_pong_public_api():
+    """BASELINE TellOnly/ping-pong shape through system.actor_of: two device
+    actors exchanging a counter token."""
+
+    @behavior("pp", {"hits": ((), F32), "peer": ((), I32)}, inbox="slots")
+    def pp(state, mailbox: Mailbox, ctx):
+        def apply(carry, t, pl):
+            return carry + pl[0]
+
+        got = mailbox.fold(jnp.asarray(0.0, F32), apply)
+        any_msg = mailbox.count > 0
+        return ({"hits": state["hits"] + got},
+                Emit.single(state["peer"], jnp.asarray([1.0]), 1, 4,
+                            when=any_msg))
+
+    system = make_system("bridge-pp")
+    try:
+        a = system.actor_of(device_props(pp), "a")
+        b = system.actor_of(
+            device_props(pp, init_state={"peer": np.asarray([0], np.int32)}),
+            "b")
+        h = get_handle(system)
+        # wire a -> b after spawn (rows are known now)
+        h.runtime.state["peer"] = h.runtime.state["peer"].at[a.row].set(b.row)
+        a.tell((0, [1.0]))     # serve
+        h.step(20)             # 20 steps of volleys on device
+        total = float(a.read_state("hits") + b.read_state("hits"))
+        assert total >= 19.0   # one hop per step after the serve lands
+    finally:
+        system.terminate()
+        system.await_termination(10.0)
+
+
+def test_device_block_ring_public_api():
+    """BASELINE ring config through the public API: one block ref, bulk
+    seed, on-device volleys, no per-actor Python objects."""
+
+    @behavior("ringb", {"received": ((), F32)}, inbox="slots")
+    def ringb(state, mailbox: Mailbox, ctx):
+        def apply(carry, t, pl):
+            return carry + pl[0]
+
+        got = mailbox.fold(jnp.asarray(0.0, F32), apply)
+        nxt = (ctx.actor_id + 1) % jnp.asarray(256, I32)
+        return ({"received": state["received"] + got},
+                Emit.single(nxt, jnp.asarray([1.0]), 1, 4,
+                            when=mailbox.count > 0))
+
+    system = make_system("bridge-ring")
+    try:
+        block = system.actor_of(device_props(ringb, n=256), "ring")
+        assert isinstance(block, DeviceBlockRef)
+        assert len(block) == 256
+        block.tell((0, [1.0]))  # one token to every actor (bulk staged)
+        h = get_handle(system)
+        h.step(10)
+        received = block.read_state("received")
+        # every executed step delivers exactly one token per actor (the
+        # auto-pump may have stepped between the tell and the explicit run,
+        # so key off the authoritative device step counter)
+        import jax
+        steps = int(jax.device_get(h.runtime.step_count))
+        assert steps >= 10
+        assert received.sum() == 256 * steps
+        # single-row ref derived from the block works
+        r0 = block[0]
+        assert isinstance(r0, DeviceActorRef)
+        assert r0.read_state("received") == received[0]
+    finally:
+        system.terminate()
+        system.await_termination(10.0)
+
+
+def test_rebuild_on_new_behavior_preserves_state():
+    """Spawning a new behavior type after the runtime is built re-traces the
+    switch while keeping rows, state and pending messages."""
+    system = make_system("bridge-rebuild")
+    try:
+        c = system.actor_of(device_props(counter), "c")
+        c.tell((ADD, [7.0]))
+        h = get_handle(system)
+        h.step()
+        assert c.read_state("count") == 7.0
+
+        @behavior("other", {"seen": ((), F32)}, inbox="slots")
+        def other(state, mailbox: Mailbox, ctx):
+            def apply(carry, t, pl):
+                return carry + pl[0]
+            return ({"seen": state["seen"] +
+                     mailbox.fold(jnp.asarray(0.0, F32), apply)},
+                    Emit.none(1, 4))
+
+        o = system.actor_of(device_props(other), "o")
+        c.tell((ADD, [3.0]))
+        o.tell((0, [2.0]))
+        h.step()
+        assert c.read_state("count") == 10.0  # old state survived rebuild
+        assert o.read_state("seen") == 2.0
+    finally:
+        system.terminate()
+        system.await_termination(10.0)
+
+
+def test_device_ref_watch_and_stop_dead_letters():
+    from akka_tpu.actor.messages import DeadLetter
+    from akka_tpu.testkit import TestProbe
+    system = make_system("bridge-watch")
+    try:
+        ref = system.actor_of(device_props(counter), "mortal")
+        probe = TestProbe(system)
+        probe.watch(ref)
+        dl_probe = TestProbe(system)
+        system.event_stream.subscribe(dl_probe.ref, DeadLetter)
+        ref.stop()
+        t = probe.expect_terminated(ref, 5.0)
+        assert t.actor is ref
+        ref.tell((ADD, [1.0]))  # late tell -> dead letters
+        dl = dl_probe.receive_one(5.0)
+        assert isinstance(dl, DeadLetter)
+    finally:
+        system.terminate()
+        system.await_termination(10.0)
+
+
+def test_default_dispatcher_tpu_batched():
+    """The north star seam: akka.actor.default-dispatcher.type=tpu-batched —
+    host actors still run (they share the dispatcher thread pool), device
+    props land on the device, through the same public API."""
+    cfg = {"akka": {"stdout-loglevel": "OFF", "log-dead-letters": 0,
+                    "actor": {"default-dispatcher": {
+                        "type": "tpu-batched",
+                        "capacity": 1 << 10, "payload-width": 4,
+                        "mailbox-slots": 4, "promise-rows": 16,
+                        "host-inbox": 1024}}}}
+    system = ActorSystem.create("bridge-default", cfg)
+    try:
+        # a plain host actor on the tpu-batched dispatcher's thread pool
+        from akka_tpu import Props
+        from akka_tpu.actor.actor import Actor
+        from akka_tpu.testkit import TestProbe
+
+        class Echo(Actor):
+            def receive(self, message):
+                self.sender.tell(("echo", message), self.self_ref)
+
+        host = system.actor_of(Props(factory=Echo, cls=Echo), "host-echo")
+        probe = TestProbe(system)
+        host.tell("hi", probe.ref)
+        assert probe.receive_one(5.0) == ("echo", "hi")
+
+        # a device actor through the same default dispatcher
+        dev = system.actor_of(device_props(counter), "dev-counter")
+        dev.tell((ADD, [4.0]))
+        assert ask_sync(dev, (GET, [0.0]), timeout=10.0)[0] == 4.0
+    finally:
+        system.terminate()
+        system.await_termination(10.0)
