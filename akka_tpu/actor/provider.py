@@ -87,14 +87,10 @@ class LocalActorRefProvider:
             # device-resident actor: rows in the tpu-batched runtime behind
             # an ordinary ref — no cell, no host mailbox (the Dispatchers
             # seam selects the backend, dispatch/Dispatchers.scala:121-259)
-            from ..dispatch.batched import TpuBatchedDispatcher
-            from ..batched.bridge import DeviceActorRef, DeviceBlockRef
-            did = props.dispatcher or system.dispatchers.DEFAULT_DISPATCHER_ID
-            disp = system.dispatchers.lookup(did)
-            if not isinstance(disp, TpuBatchedDispatcher):
-                disp = system.dispatchers.lookup("akka.actor.tpu-dispatcher")
+            from ..batched.bridge import (DeviceActorRef, DeviceBlockRef,
+                                          get_handle)
             spec = props.device
-            handle = disp.handle(system)
+            handle = get_handle(system, props.dispatcher)
             rows = handle.spawn(spec.behavior, spec.n, spec.init_state)
             if spec.n == 1:
                 return DeviceActorRef(system, handle, int(rows[0]), path,

@@ -141,6 +141,11 @@ class BatchedRuntimeHandle:
         self._promise_free: List[int] = []
         self._waiters: Dict[int, Future] = {}       # promise row -> future
         self._waiter_deadlines: Dict[int, float] = {}
+        # timed-out asks whose reply may still be in flight on device: the
+        # slot is quarantined (NOT freed) until the late reply latches or a
+        # hard deadline passes — freeing immediately could hand the slot to
+        # a new ask that then completes with the previous question's answer
+        self._promise_zombies: Dict[int, float] = {}
 
         # pump
         self._pump_thread: Optional[threading.Thread] = None
@@ -153,13 +158,14 @@ class BatchedRuntimeHandle:
 
     # -------------------------------------------------------------- behaviors
     def _behavior_index(self, b: BatchedBehavior) -> int:
-        for i, x in enumerate(self._behaviors):
-            if x is b:
-                return i
-        self._behaviors.append(b)
-        if self._runtime is not None:
-            self._rebuild()
-        return len(self._behaviors) - 1
+        with self._lock:  # registration races spawn()/runtime() callers
+            for i, x in enumerate(self._behaviors):
+                if x is b:
+                    return i
+            self._behaviors.append(b)
+            if self._runtime is not None:
+                self._rebuild()
+            return len(self._behaviors) - 1
 
     def _promise_behavior(self) -> BatchedBehavior:
         p_w = self.payload_width
@@ -198,9 +204,11 @@ class BatchedRuntimeHandle:
             return rows
 
     def stop_rows(self, rows) -> None:
-        rt = self._ensure_runtime()
+        self._ensure_runtime()
         with self._step_lock:
-            rt.stop_block(np.atleast_1d(np.asarray(rows, np.int32)))
+            # re-resolve under the lock: a concurrent _rebuild (which holds
+            # this lock) may have swapped the runtime since the build check
+            self._runtime.stop_block(np.atleast_1d(np.asarray(rows, np.int32)))
 
     def read_state(self, col: str, rows=None) -> np.ndarray:
         """Read state columns without racing an in-flight step's buffer
@@ -208,10 +216,10 @@ class BatchedRuntimeHandle:
         device gathers recompile per index-shape (seconds each over a
         tunneled backend); this is a debug/observation path, not the hot
         loop."""
-        rt = self._ensure_runtime()
+        self._ensure_runtime()
         import jax as _jax
         with self._step_lock:
-            full = np.asarray(_jax.device_get(rt.state[col]))
+            full = np.asarray(_jax.device_get(self._runtime.state[col]))
         if rows is None:
             return full
         return full[np.asarray(rows)]
@@ -285,6 +293,16 @@ class BatchedRuntimeHandle:
         rt.mail_dropped = old.mail_dropped
         rt._next_row = old._next_row
         rt._free_rows = list(old._free_rows)
+        # tells staged since the last step must survive the swap (the
+        # docstring promises in-flight contents are preserved), and a tell
+        # racing this rebuild through a stale runtime reference must not
+        # vanish: the staging buffers are SHARED by reference — old and new
+        # runtime point at the same stager / staging list / lock, so late
+        # producers land in the buffers the next flush drains
+        rt._stager = old._stager
+        rt._host_staged = old._host_staged
+        rt._lock = old._lock
+        rt._dropped_host = old._dropped_host
         rt.warmup()
         self._runtime = rt
 
@@ -313,7 +331,7 @@ class BatchedRuntimeHandle:
     # -------------------------------------------------------------------- ask
     def ask(self, row: int, message: Any, timeout: float = 5.0,
             codec: Optional[MessageCodec] = None) -> Future:
-        rt = self._ensure_runtime()
+        self._ensure_runtime()
         fut: Future = Future()
         with self._lock:
             if not self._promise_free:
@@ -324,8 +342,10 @@ class BatchedRuntimeHandle:
         c = codec or self.default_codec
         # reset the latch before reuse — under the step lock: the state
         # arrays are donated to any in-flight step and must not be touched
-        # mid-flight
+        # mid-flight (and the runtime is re-resolved under the lock so a
+        # concurrent rebuild can't hand us dropped slabs)
         with self._step_lock:
+            rt = self._runtime
             rt.state[self.PROMISE_REPLIED] = \
                 rt.state[self.PROMISE_REPLIED].at[prow].set(False)
         mtype, payload = c.encode(message, reply_to=prow)
@@ -346,11 +366,12 @@ class BatchedRuntimeHandle:
     def _resolve_waiters(self) -> None:
         with self._lock:
             waiting = list(self._waiters.items())
-        if not waiting:
+            have_zombies = bool(self._promise_zombies)
+        if not waiting and not have_zombies:
             return
-        rt = self._runtime
         base, np_ = self._promise_base, self.promise_rows_n
         with self._step_lock:  # state reads must not race donation
+            rt = self._runtime  # re-resolve: rebuild swaps under lock
             # fetch the WHOLE promise block with a static slice: constant
             # shape -> one XLA program ever (a per-waiter-count gather would
             # recompile for every distinct shape — seconds per compile over
@@ -377,13 +398,21 @@ class BatchedRuntimeHandle:
                 if now <= deadline:
                     continue
             # atomic claim: only the thread that actually pops the waiter
-            # completes the future and frees the slot (the pump and an
+            # completes the future and releases the slot (the pump and an
             # explicit step() caller may resolve concurrently)
             with self._lock:
                 if self._waiters.pop(prow, None) is None:
                     continue  # another resolver claimed it
                 _, timeout = self._waiter_deadlines.pop(prow, (0.0, 0.0))
-                self._promise_free.append(prow - self._promise_base)
+                if done:
+                    self._promise_free.append(prow - self._promise_base)
+                else:
+                    # timed out with the reply possibly still in flight:
+                    # quarantine the slot until the late reply latches (or
+                    # a hard deadline passes) so the next ask can't receive
+                    # this question's answer
+                    self._promise_zombies[prow] = now + max(5.0 * timeout,
+                                                            30.0)
             if done:
                 if not fut.done():
                     fut.set_result(c.decode(reply))
@@ -391,6 +420,13 @@ class BatchedRuntimeHandle:
                 from ..pattern.ask import AskTimeoutException
                 fut.set_exception(AskTimeoutException(
                     f"device ask timed out after [{timeout}s]"))
+        # reap quarantined slots: a latched late reply (or the hard
+        # deadline) makes the slot safe to reuse — ask() re-arms the latch
+        with self._lock:
+            for prow, kill_at in list(self._promise_zombies.items()):
+                if replied_blk[prow - base] or now > kill_at:
+                    del self._promise_zombies[prow]
+                    self._promise_free.append(prow - base)
 
     # ------------------------------------------------------------------- pump
     def _wake_pump(self) -> None:
@@ -408,7 +444,7 @@ class BatchedRuntimeHandle:
         rt = self._runtime
         if rt is None:
             return False
-        if self._waiters:
+        if self._waiters or self._promise_zombies:
             return True
         if rt._stager is not None and len(rt._stager) > 0:
             return True
@@ -418,18 +454,31 @@ class BatchedRuntimeHandle:
 
     def _pump_loop(self) -> None:
         """The registerForExecution analogue: while host work is pending,
-        step the device; otherwise park on the wake event."""
+        step the device; otherwise park on the wake event. A step failure
+        must not kill the pump (outstanding asks would hang with no timeout
+        enforcement) — it is reported and the loop continues."""
+        while not self._shutdown:
+            try:
+                self._pump_once()
+            except Exception:  # noqa: BLE001 — pump must survive
+                import traceback
+                traceback.print_exc()
+                time.sleep(0.05)
+
+    def _pump_once(self) -> None:
         while not self._shutdown:
             if self._has_pending():
-                rt = self._ensure_runtime()
+                self._ensure_runtime()
                 with self._step_lock:
+                    rt = self._runtime  # re-resolve: rebuild swaps under lock
                     self._pending_tells = 0
                     rt.step()
                     rt.block_until_ready()
                 self._resolve_waiters()
                 # a reply may need more device steps (multi-hop): keep
-                # stepping while asks are outstanding
-                if self._waiters:
+                # stepping while asks (or quarantined timed-out slots)
+                # are outstanding
+                if self._waiters or self._promise_zombies:
                     time.sleep(self.auto_step_interval)
                 continue
             self._pump_wake.wait(timeout=0.05)
@@ -437,8 +486,9 @@ class BatchedRuntimeHandle:
 
     def step(self, n: int = 1) -> None:
         """Explicit stepping for benches/tests (pump-free driving)."""
-        rt = self._ensure_runtime()
+        self._ensure_runtime()
         with self._step_lock:
+            rt = self._runtime  # re-resolve: rebuild swaps under lock
             self._pending_tells = 0  # this step flushes all staged tells
             if n == 1:
                 rt.step()

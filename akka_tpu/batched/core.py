@@ -53,7 +53,7 @@ class BatchedSystem:
     def __init__(self, capacity: int, behaviors: Sequence[BatchedBehavior],
                  payload_width: int = 4, out_degree: int = 1,
                  host_inbox: int = 1024, payload_dtype=jnp.float32,
-                 device: Optional[Any] = None, delivery: str = "sort",
+                 device: Optional[Any] = None, delivery: str = "auto",
                  need_max: bool = False, topology=None,
                  mailbox_slots: int = 0,
                  native_staging: Optional[bool] = None):

@@ -43,7 +43,7 @@ class StepCore:
     def __init__(self, behaviors: Sequence[BatchedBehavior], n_local: int,
                  payload_width: int, out_degree: int, payload_dtype,
                  slots: int = 0, need_max: bool = False, topology=None,
-                 delivery: str = "sort", n_global: Optional[int] = None):
+                 delivery: str = "auto", n_global: Optional[int] = None):
         self.behaviors = list(behaviors)
         self.n_local = int(n_local)
         self.n_global = int(n_global if n_global is not None else n_local)
@@ -125,11 +125,26 @@ class StepCore:
                                inbox_payload[:nk], inbox_valid[:nk],
                                self.need_max)
             if inbox_dst.shape[0] > nk:
-                hd = deliver(dst[nk:], inbox_payload[nk:], inbox_valid[nk:],
-                             n, self.need_max, mode="sort")
-                d = Delivery(sum=d.sum + hd.sum,
-                             max=jnp.maximum(d.max, hd.max),
-                             count=d.count + hd.count)
+                # host-injected tail: a SMALL scatter, and only when any
+                # tail row is live — in a run(n) scan the tail is consumed
+                # on the first step, so steady-state steps skip the whole
+                # delivery at runtime (lax.cond, not select)
+                tail_d, tail_p, tail_v = (dst[nk:], inbox_payload[nk:],
+                                          inbox_valid[nk:])
+
+                def with_tail(op):
+                    td, tp, tv = op
+                    hd = deliver(td, tp, tv, n, self.need_max,
+                                 mode="scatter")
+                    return Delivery(sum=d.sum + hd.sum,
+                                    max=jnp.maximum(d.max, hd.max),
+                                    count=d.count + hd.count)
+
+                def no_tail(op):
+                    return d
+
+                d = jax.lax.cond(jnp.any(tail_v), with_tail, no_tail,
+                                 (tail_d, tail_p, tail_v))
             return d
         return deliver(dst, inbox_payload, inbox_valid, n, self.need_max,
                        mode=self.delivery)

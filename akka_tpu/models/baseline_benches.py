@@ -26,13 +26,21 @@ def ring_behavior(state, inbox, ctx):
             Emit.single(nxt, inbox.sum, 1, PAYLOAD_W, when=inbox.count > 0))
 
 
-@behavior("leaf", {}, always_on=True)
-def fan_in_leaf(state, inbox, ctx):
-    # leaves 1..N target collectors 0..(n_collectors-1) by id hash
-    n_collectors = 1000
-    dst = ctx.actor_id % n_collectors
-    return {}, Emit.single(dst, jnp.array([1.0, 0, 0, 0]), 1, PAYLOAD_W,
-                           when=ctx.actor_id >= n_collectors)
+def make_fan_in_leaf(n_collectors: int = 1000):
+    """Leaf behavior targeting `n_collectors` collectors by id hash — a
+    factory so the emitted destinations always agree with the static
+    topology build_fan_in compiles for the same count."""
+
+    @behavior(f"leaf{n_collectors}", {}, always_on=True)
+    def fan_in_leaf(state, inbox, ctx):
+        dst = ctx.actor_id % n_collectors
+        return {}, Emit.single(dst, jnp.array([1.0, 0, 0, 0]), 1, PAYLOAD_W,
+                               when=ctx.actor_id >= n_collectors)
+
+    return fan_in_leaf
+
+
+fan_in_leaf = make_fan_in_leaf(1000)
 
 
 @behavior("collector", {"total": ((), jnp.float32), "msgs": ((), jnp.int32)})
@@ -104,10 +112,11 @@ def build_fan_in(n_leaves: int = 1 << 20, n_collectors: int = 1000,
         ids = np.arange(n, dtype=np.int64)
         dst_table = np.where(ids >= n_collectors, ids % n_collectors, -1)[:, None]
         topo = StaticTopology.from_dst_table(dst_table)
-    sys = BatchedSystem(capacity=n, behaviors=[fan_in_collector, fan_in_leaf],
+    leaf = fan_in_leaf if n_collectors == 1000 else make_fan_in_leaf(n_collectors)
+    sys = BatchedSystem(capacity=n, behaviors=[fan_in_collector, leaf],
                         payload_width=PAYLOAD_W, host_inbox=8, topology=topo)
     sys.spawn_block(fan_in_collector, n_collectors)
-    sys.spawn_block(fan_in_leaf, n_leaves)
+    sys.spawn_block(leaf, n_leaves)
     return sys
 
 

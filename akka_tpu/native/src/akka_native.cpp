@@ -239,6 +239,7 @@ struct Stager {
     std::atomic<int64_t> committed;   // slots fully written
     std::atomic<int64_t> pending;     // producers between reserve and commit
     std::atomic<bool> draining;       // consumer mid-drain (producers wait)
+    std::atomic<int64_t> epoch;       // completed drains (full-vs-fence tiebreak)
     int32_t* dst;
     uint8_t* payload;
     std::atomic<int64_t> dropped;
@@ -252,6 +253,7 @@ void* aq_stager_create(int64_t capacity, int64_t payload_bytes) {
     s->committed.store(0);
     s->pending.store(0);
     s->draining.store(false);
+    s->epoch.store(0);
     s->dst = new int32_t[capacity];
     s->payload = new uint8_t[capacity * payload_bytes];
     s->dropped.store(0);
@@ -270,6 +272,7 @@ int64_t aq_stager_stage(void* h, int64_t k, const int32_t* dsts,
             std::this_thread::yield();
             continue;
         }
+        int64_t seen_epoch = s->epoch.load(std::memory_order_acquire);
         s->pending.fetch_add(1, std::memory_order_acq_rel);
         int64_t start = s->cursor.fetch_add(k, std::memory_order_acq_rel);
         if (start + k <= s->capacity) {
@@ -281,7 +284,12 @@ int64_t aq_stager_stage(void* h, int64_t k, const int32_t* dsts,
             return k;
         }
         s->pending.fetch_sub(1, std::memory_order_acq_rel);
-        if (!s->draining.load(std::memory_order_acquire)) {
+        // "full" is only believable if NO drain was in flight around the
+        // failed reservation: a drain that completed between our decrement
+        // and this check (draining back to false, epoch bumped) emptied the
+        // buffer — retry instead of falsely dropping into an empty stager
+        if (!s->draining.load(std::memory_order_acquire) &&
+            s->epoch.load(std::memory_order_acquire) == seen_epoch) {
             // not a drain fence: the buffer is genuinely full
             s->dropped.fetch_add(k, std::memory_order_relaxed);
             return 0;
@@ -318,6 +326,7 @@ int64_t aq_stager_drain(void* h, int32_t* dst_out, uint8_t* payload_out) {
     std::memcpy(payload_out, s->payload, n * s->payload_bytes);
     s->committed.store(0, std::memory_order_release);
     s->cursor.store(0, std::memory_order_release);
+    s->epoch.fetch_add(1, std::memory_order_acq_rel);
     s->draining.store(false, std::memory_order_release);
     return n;
 }

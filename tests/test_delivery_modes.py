@@ -1,0 +1,113 @@
+"""Delivery-mode equivalence: merge / sort / scatter must agree bit-for-bit.
+
+The merge mode (gather/scatter-free marker sort) is the TPU hot path; the
+scatter mode is the reference semantics (segment_sum). Reference contract:
+every message reaches exactly its recipient's inbox once —
+dispatch/Mailbox.scala:260-277.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from akka_tpu.ops.segment import Delivery, deliver, deliver_slots
+
+
+def _random_case(seed, m, n, p=4, frac_invalid=0.2):
+    rng = np.random.default_rng(seed)
+    dst = rng.integers(-2, n + 2, size=m).astype(np.int32)  # some out of range
+    payload = rng.standard_normal((m, p)).astype(np.float32)
+    valid = rng.random(m) > frac_invalid
+    return jnp.asarray(dst), jnp.asarray(payload), jnp.asarray(valid)
+
+
+@pytest.mark.parametrize("seed,m,n", [(0, 64, 16), (1, 1000, 37),
+                                      (2, 4096, 4096), (3, 300, 1)])
+def test_modes_agree(seed, m, n):
+    dst, payload, valid = _random_case(seed, m, n)
+    ref = deliver(dst, payload, valid, n, need_max=True, mode="scatter")
+    for mode in ("sort", "merge"):
+        got = deliver(dst, payload, valid, n, need_max=True, mode=mode)
+        # cumsum-difference sums accumulate f32 rounding over long prefixes;
+        # scatter-add does not — allow that float slack, not a logic slack
+        np.testing.assert_allclose(np.asarray(got.sum), np.asarray(ref.sum),
+                                   rtol=1e-4, atol=1e-3, err_msg=mode)
+        np.testing.assert_array_equal(np.asarray(got.count),
+                                      np.asarray(ref.count), err_msg=mode)
+        np.testing.assert_allclose(np.asarray(got.max), np.asarray(ref.max),
+                                   rtol=1e-6, err_msg=mode)
+
+
+def test_merge_empty_and_full():
+    n, m, p = 8, 32, 4
+    # no valid messages
+    d = deliver(jnp.zeros((m,), jnp.int32), jnp.ones((m, p)),
+                jnp.zeros((m,), bool), n, mode="merge")
+    assert int(d.count.sum()) == 0
+    assert float(jnp.abs(d.sum).sum()) == 0.0
+    # all to one actor
+    d = deliver(jnp.full((m,), 3, jnp.int32), jnp.ones((m, p)),
+                jnp.ones((m,), bool), n, need_max=True, mode="merge")
+    assert int(d.count[3]) == m
+    assert float(d.sum[3, 0]) == m
+    assert float(d.max[3, 0]) == 1.0
+    assert int(d.count.sum()) == m
+
+
+def test_slots_fifo_order_per_sender():
+    """Slot delivery preserves arrival (== per-sender FIFO) order and agrees
+    with a numpy oracle on counts/sums."""
+    rng = np.random.default_rng(7)
+    n, m, p, s = 13, 200, 3, 4
+    dst = rng.integers(0, n, size=m).astype(np.int32)
+    mtype = rng.integers(0, 5, size=m).astype(np.int32)
+    payload = rng.standard_normal((m, p)).astype(np.float32)
+    valid = rng.random(m) > 0.1
+
+    out = deliver_slots(jnp.asarray(dst), jnp.asarray(mtype),
+                        jnp.asarray(payload), jnp.asarray(valid), n, s,
+                        need_max=True)
+    types = np.asarray(out.types)
+    pl = np.asarray(out.payload)
+    vv = np.asarray(out.valid)
+    counts = np.asarray(out.count)
+    sums = np.asarray(out.sum)
+    maxs = np.asarray(out.max)
+
+    total_dropped = 0
+    for a in range(n):
+        idx = [i for i in range(m) if valid[i] and dst[i] == a]
+        assert counts[a] == len(idx)
+        kept = idx[:s]
+        for r in range(s):
+            if r < len(kept):
+                assert vv[a, r]
+                assert types[a, r] == mtype[kept[r]]
+                np.testing.assert_allclose(pl[a, r], payload[kept[r]],
+                                           rtol=1e-6)
+            else:
+                assert not vv[a, r]
+        if idx:
+            np.testing.assert_allclose(sums[a], payload[idx].sum(0),
+                                       rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(maxs[a], payload[idx].max(0),
+                                       rtol=1e-6)
+        else:
+            np.testing.assert_array_equal(sums[a], 0)
+        total_dropped += max(0, len(idx) - s)
+    assert int(out.dropped) == total_dropped
+
+
+def test_modes_agree_jit_under_scan():
+    """The merge path must be scan-safe (the run(n) hot loop wraps it)."""
+    dst, payload, valid = _random_case(11, 512, 128)
+
+    def step(carry, _):
+        d = deliver(dst, payload, valid, 128, mode="merge")
+        return carry + d.sum.sum(), None
+
+    total, _ = jax.lax.scan(jax.jit(step), jnp.asarray(0.0), None, length=3)
+    ref = deliver(dst, payload, valid, 128, mode="scatter")
+    np.testing.assert_allclose(float(total), 3 * float(ref.sum.sum()),
+                               rtol=1e-4)
