@@ -444,7 +444,7 @@ class BatchedRuntimeHandle:
         rt = self._runtime
         if rt is None:
             return False
-        if self._waiters or self._promise_zombies:
+        if self._waiters:
             return True
         if rt._stager is not None and len(rt._stager) > 0:
             return True
@@ -463,7 +463,14 @@ class BatchedRuntimeHandle:
             except Exception:  # noqa: BLE001 — pump must survive
                 import traceback
                 traceback.print_exc()
-                time.sleep(0.05)
+                # timeout enforcement lives in _resolve_waiters: on a
+                # persistently failing step, outstanding asks must still
+                # time out rather than hang their callers forever
+                try:
+                    self._resolve_waiters()
+                except Exception:  # noqa: BLE001
+                    pass
+                time.sleep(0.5)
 
     def _pump_once(self) -> None:
         while not self._shutdown:
@@ -476,13 +483,23 @@ class BatchedRuntimeHandle:
                     rt.block_until_ready()
                 self._resolve_waiters()
                 # a reply may need more device steps (multi-hop): keep
-                # stepping while asks (or quarantined timed-out slots)
-                # are outstanding
-                if self._waiters or self._promise_zombies:
+                # stepping while asks are outstanding
+                if self._waiters:
                     time.sleep(self.auto_step_interval)
                 continue
             self._pump_wake.wait(timeout=0.05)
             self._pump_wake.clear()
+            if self._promise_zombies and not self._shutdown:
+                # quarantined timed-out slots: step at a LOW cadence (their
+                # late replies free the slots; a flat-out step loop would
+                # burn the device for the whole quarantine window)
+                time.sleep(0.25)
+                self._ensure_runtime()
+                with self._step_lock:
+                    rt = self._runtime
+                    rt.step()
+                    rt.block_until_ready()
+                self._resolve_waiters()
 
     def step(self, n: int = 1) -> None:
         """Explicit stepping for benches/tests (pump-free driving)."""

@@ -127,19 +127,32 @@ class ShardedBatchedSystem:
                 dst_offset=base, id_base=base)
 
             # ---- route: bucket by destination shard, exchange over ICI ----
+            # ONE stable keyed sort carries every column through the sort
+            # network (argsort + x[order] gathers serialize on TPU); rank
+            # within the shard group comes from a cummax over head flags
+            # instead of a searchsorted table gather
             slots_mode = self.mailbox_slots > 0
             out_dst = emits.dst.reshape(-1)                       # [n_local*k]
             out_payload = emits.payload.reshape(-1, p_w)
+            out_type = emits.type.reshape(-1)
             out_valid = emits.valid.reshape(-1) & (out_dst >= 0) & (out_dst < n_global)
             dest_shard = jnp.where(out_valid, out_dst // n_local, n_shards)
 
-            order = jnp.argsort(dest_shard, stable=True)
-            ds_sorted = dest_shard[order]
-            dst_sorted = out_dst[order]
-            pl_sorted = out_payload[order]
-            ok_sorted = out_valid[order]
-            group_start = jnp.searchsorted(ds_sorted, jnp.arange(n_shards + 1))
-            rank = jnp.arange(ds_sorted.shape[0]) - group_start[ds_sorted]
+            m = out_dst.shape[0]
+            iota = jnp.arange(m, dtype=jnp.int32)
+            fcols = tuple(out_payload[:, i] for i in range(p_w))
+            tcol = (out_type,) if slots_mode else ()  # type rides only if read
+            srt = jax.lax.sort(
+                (dest_shard.astype(jnp.int32), iota, out_dst,
+                 out_valid.astype(jnp.int32)) + tcol + fcols, num_keys=2)
+            ds_sorted, dst_sorted = srt[0], srt[2]
+            ok_sorted = srt[3].astype(jnp.bool_)
+            type_sorted = srt[4] if slots_mode else None
+            pl_sorted = jnp.stack(srt[4 + len(tcol):], axis=1)
+            head = jnp.concatenate([jnp.ones((1,), jnp.bool_),
+                                    ds_sorted[1:] != ds_sorted[:-1]])
+            start = jax.lax.cummax(jnp.where(head, iota, -1))
+            rank = iota - start
             in_cap = ok_sorted & (rank < pair_cap) & (ds_sorted < n_shards)
             slot = jnp.where(in_cap, ds_sorted * pair_cap + rank,
                              n_shards * pair_cap)  # overflow bucket
@@ -170,8 +183,6 @@ class ShardedBatchedSystem:
             if slots_mode:
                 # the type column rides the exchange only when somebody
                 # reads it — reduce-mode systems skip a whole collective
-                out_type = emits.type.reshape(-1)
-                type_sorted = out_type[order]
                 buf_type = jnp.zeros((n_shards * pair_cap + 1,), jnp.int32)
                 buf_type = buf_type.at[slot].set(
                     jnp.where(in_cap, type_sorted, 0))[:-1]

@@ -112,11 +112,79 @@ def build_fan_in(n_leaves: int = 1 << 20, n_collectors: int = 1000,
         ids = np.arange(n, dtype=np.int64)
         dst_table = np.where(ids >= n_collectors, ids % n_collectors, -1)[:, None]
         topo = StaticTopology.from_dst_table(dst_table)
-    leaf = fan_in_leaf if n_collectors == 1000 else make_fan_in_leaf(n_collectors)
+    leaf = make_fan_in_leaf(n_collectors)
     sys = BatchedSystem(capacity=n, behaviors=[fan_in_collector, leaf],
                         payload_width=PAYLOAD_W, host_inbox=8, topology=topo)
     sys.spawn_block(fan_in_collector, n_collectors)
     sys.spawn_block(leaf, n_leaves)
+    return sys
+
+
+def make_router_producer(routee_base: int, n_routees: int):
+    """RoundRobinPool semantics, tensorized (BASELINE config 4): each
+    producer's successive messages hit successive routees — the pool's
+    routing logic is an index map applied at emission (SURVEY.md §2.11;
+    reference: routing/Router.scala:116 route fan-out without the router's
+    mailbox). The shifting (id + step) pattern defeats the static-topology
+    compiler on purpose: this bench measures DYNAMIC delivery."""
+
+    @behavior(f"producer{n_routees}", {}, always_on=True)
+    def producer(state, inbox, ctx):
+        dst = routee_base + (ctx.actor_id + ctx.step) % n_routees
+        return {}, Emit.single(dst, jnp.array([1.0, 0, 0, 0]), 1, PAYLOAD_W,
+                               when=ctx.actor_id >= routee_base + n_routees)
+
+    return producer
+
+
+@behavior("routee", {"hits": ((), jnp.int32)})
+def routee(state, inbox, ctx):
+    return ({"hits": state["hits"] + inbox.count}, Emit.none(1, PAYLOAD_W))
+
+
+def build_router(n_producers: int = 1 << 20, n_routees: int = 100_000):
+    """Config 4: RoundRobin router pool, 100k routees, producers telling
+    every step. Routees occupy rows [0, n_routees); producers the rest."""
+    n = n_routees + n_producers
+    producer = make_router_producer(0, n_routees)
+    sys = BatchedSystem(capacity=n, behaviors=[routee, producer],
+                        payload_width=PAYLOAD_W, host_inbox=8)
+    sys.spawn_block(routee, n_routees)
+    sys.spawn_block(producer, n_producers)
+    return sys
+
+
+def make_crossshard_behavior(local_n: int):
+    """Entity that forwards its token to the SAME slot in the next device
+    shard — every single message crosses the mesh (all_to_all hot path)."""
+
+    @behavior("xshard", {"received": ((), jnp.int32)})
+    def xshard(state, inbox, ctx):
+        nxt = (ctx.actor_id + local_n) % ctx.n_actors
+        return ({"received": state["received"] + inbox.count},
+                Emit.single(nxt, inbox.sum, 1, PAYLOAD_W,
+                            when=inbox.count > 0))
+
+    return xshard
+
+
+def build_cross_shard(n_shards: int = 256, entities_per_shard: int = 4096,
+                      n_devices=None):
+    """Config 5: 256 logical shards x 4k entities on the device mesh with
+    cross-shard tells (sharding/ShardRegion.scala:1046 deliverMessage as an
+    all_to_all). Logical shards are folded onto the devices; every tell hops
+    one device shard, so all traffic rides the exchange."""
+    import jax as _jax
+    n = n_shards * entities_per_shard
+    if n_devices is None:
+        n_devices = len(_jax.devices())
+    if n % n_devices:
+        n += n_devices - n % n_devices
+    b = make_crossshard_behavior(n // n_devices)
+    sys = ShardedBatchedSystem(capacity=n, behaviors=[b],
+                               n_devices=n_devices, payload_width=PAYLOAD_W,
+                               host_inbox_per_shard=8)
+    sys.spawn_block(b, n)
     return sys
 
 

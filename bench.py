@@ -1,11 +1,17 @@
 #!/usr/bin/env python
-"""Headline benchmark: actor.tell() throughput on the 1M-actor ring.
+"""BASELINE bench surface: all five configs + latency percentiles.
 
 BASELINE.json: target 100M actor.tell()/sec on 1M concurrent actors
-(>=10x the ForkJoinDispatcher JMH baseline, i.e. baseline ~= 10M msg/s).
+(>=10x the ForkJoinDispatcher JMH baseline ~= 10M msg/s), p50 latency
+tracked alongside, configs:
+  1. 2-actor ping-pong (TellOnly)        -> latency percentiles
+  2. 1M-actor ring                       -> headline (static) + dynamic mode
+  3. 1M -> 1k fan-in aggregator
+  4. RoundRobinPool 100k routees         -> dynamic delivery (shifting map)
+  5. 256 shards x 4k entities cross-shard tells on the device mesh
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Extra detail goes to stderr. --smoke runs a tiny config for CI.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
+Detail goes to stderr. --smoke runs tiny configs for CI; --config X runs one.
 """
 
 import argparse
@@ -17,90 +23,160 @@ import time
 BASELINE_MSGS_PER_SEC = 10_000_000  # implied ForkJoinDispatcher JMH reference
 
 
+def _throughput(sys_, steps: int, msgs_per_step: int, warmup: int):
+    sys_.run(warmup)
+    sys_.block_until_ready()
+    t0 = time.perf_counter()
+    sys_.run(steps)
+    sys_.block_until_ready()
+    dt = time.perf_counter() - t0
+    return msgs_per_step * steps / dt, dt
+
+
+def bench_ring(n, steps, static=True):
+    from akka_tpu.models.baseline_benches import build_ring, seed_ring_full
+    s = build_ring(n, static=static)
+    seed_ring_full(s)
+    rate, dt = _throughput(s, steps, n, warmup=steps)
+    recv = s.read_state("received")
+    ok = bool((recv == 2 * steps).all())
+    return rate, dt, ok
+
+
+def bench_fan_in(n_leaves, steps):
+    from akka_tpu.models.baseline_benches import build_fan_in
+    s = build_fan_in(n_leaves=n_leaves, n_collectors=1000)
+    rate, dt = _throughput(s, steps, n_leaves, warmup=2)
+    msgs = s.read_state("msgs")[:1000]
+    # always_on leaves emit every step; deliveries lag one step
+    ok = bool(msgs.sum() == (steps + 2 - 1) * n_leaves)
+    return rate, dt, ok
+
+
+def bench_router(n_producers, n_routees, steps):
+    from akka_tpu.models.baseline_benches import build_router
+    s = build_router(n_producers=n_producers, n_routees=n_routees)
+    rate, dt = _throughput(s, steps, n_producers, warmup=2)
+    hits = s.read_state("hits")[:n_routees]
+    ok = bool(hits.sum() == (steps + 2 - 1) * n_producers)
+    return rate, dt, ok
+
+
+def bench_cross_shard(n_shards, per_shard, steps):
+    from akka_tpu.models.baseline_benches import (build_cross_shard,
+                                                  seed_ring_full)
+    s = build_cross_shard(n_shards=n_shards, entities_per_shard=per_shard)
+    seed_ring_full(s)
+    n = s.capacity
+    rate, dt = _throughput(s, steps, n, warmup=4)
+    recv = s.read_state("received")
+    ok = bool((recv == steps + 4).all()) and s.total_dropped == 0
+    return rate, dt, ok
+
+
+def bench_latency(rounds):
+    """Config 1: mailbox-to-receive latency — host tell -> one device step
+    -> processed. The whole visible path, not just the enqueue."""
+    from akka_tpu.models.baseline_benches import build_ping_pong
+    s = build_ping_pong()
+    # warm the exact programs the timed loop uses (flush + single step)
+    s.tell(0, [1.0, 0, 0, 0])
+    s.step()
+    s.step()
+    s.block_until_ready()
+    samples = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        s.tell(0, [1.0, 0, 0, 0])
+        s.step()
+        s.block_until_ready()
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    p = lambda q: samples[min(int(q * len(samples)), len(samples) - 1)]
+    return {"p50_us": round(p(0.50) * 1e6, 1),
+            "p99_us": round(p(0.99) * 1e6, 1),
+            "rounds": rounds}
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny config, CPU-ok")
     ap.add_argument("--actors", type=int, default=1 << 20)
     ap.add_argument("--steps", type=int, default=64)
-    ap.add_argument("--warmup", type=int, default=0,
-                    help="warmup steps (default: same as --steps so the scan "
-                         "compiles once for the measured length)")
-    ap.add_argument("--all", action="store_true", help="also run fan-in/ping-pong to stderr")
+    ap.add_argument("--config", choices=["ring", "ring-dynamic", "fan-in",
+                                         "router", "shard", "latency"],
+                    help="run a single config")
     args = ap.parse_args()
 
+    n = args.actors
+    steps = args.steps
+    lat_rounds = 200
+    shard_counts = (256, 4096)
+    router_counts = (n, 100_000)
+    fan_leaves = n
     if args.smoke:
-        args.actors, args.steps = 1 << 12, 8
-    if args.warmup <= 0:
-        args.warmup = args.steps  # same scan length -> one compile
+        n, steps, lat_rounds = 1 << 12, 8, 20
+        shard_counts = (8, 64)
+        router_counts = (1 << 12, 100)
+        fan_leaves = 1 << 12
 
     import jax
-    from akka_tpu.models.baseline_benches import build_ring, seed_ring_full
-
     dev = jax.devices()[0]
     print(f"[bench] device: {dev.platform}:{dev.device_kind} "
-          f"actors={args.actors} steps={args.steps}", file=sys.stderr)
+          f"actors={n} steps={steps}", file=sys.stderr)
 
-    sys_ = build_ring(args.actors)
-    seed_ring_full(sys_)
+    extra = {}
 
-    # warmup (compile)
-    t0 = time.perf_counter()
-    sys_.run(args.warmup)
-    sys_.block_until_ready()
-    print(f"[bench] compile+warmup: {time.perf_counter()-t0:.1f}s", file=sys.stderr)
-
-    t0 = time.perf_counter()
-    sys_.run(args.steps)
-    sys_.block_until_ready()
-    elapsed = time.perf_counter() - t0
-
-    delivered = args.actors * args.steps  # every actor processes 1 msg per step
-    msgs_per_sec = delivered / elapsed
-
-    # correctness guard: each actor received warmup+steps messages
-    recv = sys_.read_state("received")
-    expected = args.warmup + args.steps
-    ok = bool((recv == expected).all())
-    print(f"[bench] elapsed={elapsed:.3f}s delivered={delivered:,} "
-          f"({msgs_per_sec/1e6:.1f}M msg/s) correctness={'OK' if ok else 'FAIL'}",
-          file=sys.stderr)
-    if not ok:
-        print(f"[bench] expected {expected}, got min={recv.min()} max={recv.max()}",
+    def run_one(name, fn):
+        t0 = time.perf_counter()
+        out = fn()
+        if name == "latency":
+            extra["latency"] = out
+            print(f"[bench] latency: p50={out['p50_us']}us "
+                  f"p99={out['p99_us']}us", file=sys.stderr)
+            return None
+        rate, dt, ok = out
+        extra[name] = {"msgs_per_sec": round(rate, 0), "ok": ok}
+        print(f"[bench] {name}: {rate/1e6:.1f}M msg/s "
+              f"({dt*1e3/steps:.3f} ms/step) correct={'OK' if ok else 'FAIL'} "
+              f"[total {time.perf_counter()-t0:.1f}s incl compile]",
               file=sys.stderr)
+        return rate
 
-    if args.all:
-        _extra_benches(args, file=sys.stderr)
+    configs = {
+        "ring": lambda: bench_ring(n, steps, static=True),
+        "ring-dynamic": lambda: bench_ring(n, steps, static=False),
+        "fan-in": lambda: bench_fan_in(fan_leaves, steps),
+        "router": lambda: bench_router(*router_counts, steps),
+        "shard": lambda: bench_cross_shard(*shard_counts, steps),
+        "latency": lambda: bench_latency(lat_rounds),
+    }
+
+    if args.config == "latency":
+        out = bench_latency(lat_rounds)
+        print(json.dumps({
+            "metric": "mailbox-to-receive latency, 2-actor ping-pong (p50)",
+            "value": out["p50_us"], "unit": "us",
+            "vs_baseline": 1.0, "extra": {"latency": out}}))
+        return
+    if args.config:
+        headline = run_one(args.config, configs[args.config])
+    else:
+        headline = run_one("ring", configs["ring"])
+        for name in ("ring-dynamic", "fan-in", "router", "shard", "latency"):
+            try:
+                run_one(name, configs[name])
+            except Exception as e:  # noqa: BLE001 — partial surface > none
+                extra[name] = {"error": repr(e)[:200]}
+                print(f"[bench] {name}: ERROR {e!r}", file=sys.stderr)
 
     print(json.dumps({
         "metric": "actor.tell() throughput, 1M-actor ring (uniform 1-msg mailbox)",
-        "value": round(msgs_per_sec, 0),
+        "value": round(headline, 0),
         "unit": "msgs/sec",
-        "vs_baseline": round(msgs_per_sec / BASELINE_MSGS_PER_SEC, 2),
+        "vs_baseline": round(headline / BASELINE_MSGS_PER_SEC, 2),
+        "extra": extra,
     }))
-
-
-def _extra_benches(args, file) -> None:
-    import time as _t
-    from akka_tpu.models.baseline_benches import build_fan_in, build_ping_pong
-
-    n_leaves = min(args.actors, 1 << 20)
-    fi = build_fan_in(n_leaves=n_leaves, n_collectors=1000)
-    fi.run(2); fi.block_until_ready()
-    t0 = _t.perf_counter()
-    fi.run(args.steps); fi.block_until_ready()
-    dt = _t.perf_counter() - t0
-    print(f"[bench] fan-in {n_leaves}->1000: "
-          f"{n_leaves*args.steps/dt/1e6:.1f}M msg/s", file=file)
-
-    pp = build_ping_pong()
-    pp.tell(0, [1.0, 0, 0, 0])
-    pp.run(2); pp.block_until_ready()
-    t0 = _t.perf_counter()
-    pp.run(1000); pp.block_until_ready()
-    dt = _t.perf_counter() - t0
-    print(f"[bench] ping-pong: {1000/dt:.0f} round-trips/s "
-          f"(p50 step latency {dt:.4f}/1000 = {dt*1e3:.3f}ms... per-step {dt:.3f}us)",
-          file=file)
 
 
 if __name__ == "__main__":
