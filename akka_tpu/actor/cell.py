@@ -266,6 +266,9 @@ class ActorCell:
             if not self._behavior_stack:
                 self._behavior_stack = [instance.receive]
             instance.pre_start()
+            _fr = self.system.flight_recorder
+            if _fr.enabled:
+                _fr.actor_spawned(str(self.self_ref.path))
             if self.system.settings.debug_lifecycle:
                 self._log_debug("started")
         except ActorInitializationException:
@@ -286,6 +289,9 @@ class ActorCell:
             return
         self._failed_perpetrator = self.self_ref
         self._failure_cause = cause
+        _fr = self.system.flight_recorder
+        if _fr.enabled:
+            _fr.actor_failed(str(self.self_ref.path), repr(cause))
         try:
             self.suspend_self_and_children()
             if self.parent is not None:
@@ -396,6 +402,9 @@ class ActorCell:
             fresh._cell = self
             self._behavior_stack = [fresh.receive]
             fresh.post_restart(cause)
+            _fr = self.system.flight_recorder
+            if _fr.enabled:
+                _fr.actor_restarted(str(self.self_ref.path), repr(cause))
             if self.system.settings.debug_lifecycle:
                 self._log_debug("restarted")
             if self.mailbox.resume():
@@ -434,6 +443,9 @@ class ActorCell:
             return
         self._terminated = True
         self._terminating = True
+        _fr = self.system.flight_recorder
+        if _fr.enabled:
+            _fr.actor_stopped(str(self.self_ref.path))
         actor = self.actor
         try:
             if actor is not None:

@@ -134,6 +134,11 @@ class ActorSystem:
         self._stdout_logger = StdOutLogger(level_for(self.settings.stdout_loglevel))
         self.event_stream.attach_tap(self._stdout_filtered)
 
+        # flight recorder: runtime-selected tracing SPI, noop by default
+        # (JFRActorFlightRecorder selection parity, SURVEY.md §2.10 item 9)
+        from ..event.flight_recorder import from_config as _fr_from_config
+        self.flight_recorder = _fr_from_config(cfg)
+
         sched_impl = cfg.get_string("akka.scheduler.implementation", "default")
         self.scheduler = None
         if sched_impl == "native":
@@ -261,6 +266,7 @@ class ActorSystem:
     def _finish_terminate(self) -> None:
         self.dispatchers.shutdown()
         self.scheduler.shutdown()
+        self.flight_recorder.close()
         self._terminated.set()
         for cb in self._termination_callbacks:
             try:

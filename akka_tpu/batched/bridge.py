@@ -117,7 +117,8 @@ class BatchedRuntimeHandle:
                  out_degree: int = 1, host_inbox: int = 4096,
                  mailbox_slots: int = 0, promise_rows: int = 256,
                  auto_step_interval: float = 0.001,
-                 payload_dtype=jnp.float32, event_stream=None):
+                 payload_dtype=jnp.float32, event_stream=None,
+                 flight_recorder=None):
         self.capacity = capacity
         self.payload_width = payload_width
         self.out_degree = out_degree
@@ -127,6 +128,7 @@ class BatchedRuntimeHandle:
         self.auto_step_interval = auto_step_interval
         self.payload_dtype = payload_dtype
         self.event_stream = event_stream
+        self.flight_recorder = flight_recorder
         self.default_codec = DefaultCodec(payload_width,
                                           np.dtype(jnp.dtype(payload_dtype)))
 
@@ -244,6 +246,7 @@ class BatchedRuntimeHandle:
             mailbox_slots=self.mailbox_slots)
         if self.event_stream is not None:
             rt.on_dropped = self._publish_dropped
+        rt.flight_recorder = self.flight_recorder
         for rec in self._spawns:
             got = rt.spawn_block(behaviors.index(rec.behavior), rec.n,
                                  rec.init_state)
@@ -276,6 +279,7 @@ class BatchedRuntimeHandle:
             mailbox_slots=self.mailbox_slots)
         if self.event_stream is not None:
             rt.on_dropped = self._publish_dropped
+        rt.flight_recorder = self.flight_recorder
         for col, arr in old.state.items():
             if col in rt.state:
                 rt.state[col] = arr
@@ -492,8 +496,12 @@ class BatchedRuntimeHandle:
             if self._promise_zombies and not self._shutdown:
                 # quarantined timed-out slots: step at a LOW cadence (their
                 # late replies free the slots; a flat-out step loop would
-                # burn the device for the whole quarantine window)
-                time.sleep(0.25)
+                # burn the device for the whole quarantine window). The
+                # interruptible wait lets fresh asks/tells wake us early.
+                self._pump_wake.wait(timeout=0.25)
+                self._pump_wake.clear()
+                if self._has_pending():
+                    continue  # fresh work takes the fast path above
                 self._ensure_runtime()
                 with self._step_lock:
                     rt = self._runtime

@@ -27,6 +27,7 @@ in-flight message and runs every live actor's update, entirely on device.
 from __future__ import annotations
 
 import os
+import time as _time
 import threading
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -108,6 +109,9 @@ class BatchedSystem:
         # dispatch/Mailbox.scala:415-443): the dispatcher bridge wires this
         # to the EventStream so host_inbox overflow surfaces as Dropped
         self.on_dropped: Optional[Callable[[int], None]] = None
+        # optional FlightRecorder (event/flight_recorder.py SPI): step/flush
+        # events for post-mortem traces; None = zero overhead
+        self.flight_recorder = None
         # native staging buffer: producers memcpy rows into a preallocated
         # C++ buffer with one atomic reserve, the flush drains a contiguous
         # block (SURVEY.md §2.10 item 5 — envelope-pool parity). Rows carry
@@ -294,6 +298,8 @@ class BatchedSystem:
             else:
                 self._flush_payload[:k] = rows_np
             self._run_flush(k)
+            if self.flight_recorder is not None:
+                self.flight_recorder.device_flush("batched", k)
             return
         with self._lock:
             staged, self._host_staged = self._host_staged, []
@@ -311,6 +317,8 @@ class BatchedSystem:
         self._flush_type[:k] = [t for _, t, _ in staged]
         self._flush_payload[:k] = np.stack([p for _, _, p in staged])
         self._run_flush(k)
+        if self.flight_recorder is not None:
+            self.flight_recorder.device_flush("batched", k)
 
     # ------------------------------------------------------------------ step
     def _step_impl(self, state, behavior_id, alive, inbox_dst, inbox_type,
@@ -363,14 +371,29 @@ class BatchedSystem:
 
     def step(self) -> None:
         """One delivery+update step (flushes host tells first)."""
+        from ..event.flight_recorder import trace_span
         self._flush_staged()
-        self._set_carry(self._step_jit(*self._carry(), self._topo_arrays))
+        t0 = _time.perf_counter()
+        with trace_span("akka.device.step"):
+            self._set_carry(self._step_jit(*self._carry(), self._topo_arrays))
+        fr = self.flight_recorder
+        if fr is not None:
+            # elapsed_s is DISPATCH time (launch is async; the device may
+            # still be executing) — slow dispatches still flag recompiles
+            # and host stalls in a post-mortem flight
+            fr.device_step("batched", 1, _time.perf_counter() - t0)
 
     def run(self, n_steps: int) -> None:
         """n steps fully on device (lax.scan) — the bench hot loop."""
+        from ..event.flight_recorder import trace_span
         self._flush_staged()
-        self._set_carry(self._run_jit(*self._carry(), n_steps,
-                                      self._topo_arrays))
+        t0 = _time.perf_counter()
+        with trace_span(f"akka.device.run[{n_steps}]"):
+            self._set_carry(self._run_jit(*self._carry(), n_steps,
+                                          self._topo_arrays))
+        fr = self.flight_recorder
+        if fr is not None:
+            fr.device_step("batched", n_steps, _time.perf_counter() - t0)
 
     def warmup(self) -> None:
         """Execute the step AND the flush once on throwaway zero-filled
@@ -379,6 +402,7 @@ class BatchedSystem:
         lower().compile()) is required: some backends (axon tunnel) miss the
         dispatch cache for AOT-compiled donated signatures. The clones are
         donated and freed; our live carry is untouched."""
+        t0 = _time.perf_counter()
         clone = jax.tree.map(jnp.zeros_like, self._carry())
         out = self._step_jit(*clone, self._topo_arrays)
         jax.tree.map(lambda a: a.delete() if hasattr(a, "delete") else None,
@@ -393,6 +417,9 @@ class BatchedSystem:
             jnp.asarray(self._flush_valid))
         jax.tree.map(lambda a: a.delete() if hasattr(a, "delete") else None,
                      out)
+        if self.flight_recorder is not None:
+            self.flight_recorder.device_compile(
+                "batched", _time.perf_counter() - t0)
 
     def block_until_ready(self) -> None:
         # sync via a host read of a non-donated output: on some platforms

@@ -53,6 +53,7 @@ class TpuBatchedDispatcher(Dispatcher):
                     auto_step_interval=c.get_duration(
                         "auto-step-interval", "1ms"),
                     event_stream=getattr(system, "event_stream", None),
+                    flight_recorder=getattr(system, "flight_recorder", None),
                 )
             return self._handle
 

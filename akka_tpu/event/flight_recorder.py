@@ -1,0 +1,218 @@
+"""Flight recorder: structured runtime tracing behind a noop-by-default SPI.
+
+Reference parity: the JDK Flight Recorder emitters selected at runtime —
+typed actor events (akka-actor-typed/src/main/scala-jdk-9/akka/actor/typed/
+internal/jfr/JFRActorFlightRecorder.scala, noop fallback
+typed/internal/ActorFlightRecorder.scala) and remoting events
+(akka-remote/src/main/scala-jdk-9/akka/remote/artery/jfr/Events.scala), with
+hook points through ArteryTransport.start (ArteryTransport.scala:344,436-466).
+
+The TPU translation (SURVEY.md §2.10 item 9): the host control plane emits
+structured events into a pluggable recorder (noop / in-memory ring / JSONL
+file), and the device hot path is annotated with jax.profiler traces —
+`with trace_span("akka.step")` brackets show up in a TensorBoard/XProf trace
+captured via start_trace()/stop_trace() (or bench.py --trace DIR).
+
+Selection mirrors the reference's runtime pick: config
+`akka.flight-recorder.implementation = noop|memory|jsonl` read at system
+bootstrap; `noop` costs one no-inlined method call per hook, nothing else.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+
+class FlightRecorder:
+    """SPI. Every hook is fire-and-forget and must never raise into the
+    caller; implementations are thread-safe. Callers building non-trivial
+    hook arguments (path strings, reprs) should gate on `enabled` so the
+    noop configuration pays one attribute read, nothing else."""
+
+    enabled = True
+
+    # -- actor lifecycle (JFRActorFlightRecorder parity) ---------------------
+    def actor_spawned(self, path: str) -> None: ...
+    def actor_stopped(self, path: str) -> None: ...
+    def actor_failed(self, path: str, cause: str) -> None: ...
+    def actor_restarted(self, path: str, cause: str) -> None: ...
+
+    # -- remoting (artery/jfr/Events.scala parity) ---------------------------
+    def transport_started(self, address: str) -> None: ...
+    def association_opened(self, peer: str) -> None: ...
+    def association_quarantined(self, peer: str, reason: str) -> None: ...
+    def remote_message_sent(self, peer: str, size: int) -> None: ...
+    def remote_message_received(self, peer: str, size: int) -> None: ...
+
+    # -- device runtime (no reference analogue; the TPU data plane) ----------
+    def device_step(self, system: str, n_steps: int, elapsed_s: float) -> None: ...
+    def device_flush(self, system: str, staged: int) -> None: ...
+    def device_compile(self, system: str, elapsed_s: float) -> None: ...
+    def dropped(self, system: str, count: int) -> None: ...
+
+    # -- generic escape hatch ------------------------------------------------
+    def event(self, name: str, **fields: Any) -> None: ...
+
+    def events(self) -> List[Dict[str, Any]]:
+        return []
+
+    def close(self) -> None: ...
+
+
+class NoOpFlightRecorder(FlightRecorder):
+    """Default: every hook is a pass (ActorFlightRecorder noop parity)."""
+
+    enabled = False
+
+
+def _structured(method_name):
+    def hook(self, *args):
+        self._record(method_name, args)
+    return hook
+
+
+class InMemoryFlightRecorder(FlightRecorder):
+    """Bounded ring of structured events; the testkit/debug recorder."""
+
+    _FIELDS = {
+        "actor_spawned": ("path",),
+        "actor_stopped": ("path",),
+        "actor_failed": ("path", "cause"),
+        "actor_restarted": ("path", "cause"),
+        "transport_started": ("address",),
+        "association_opened": ("peer",),
+        "association_quarantined": ("peer", "reason"),
+        "remote_message_sent": ("peer", "size"),
+        "remote_message_received": ("peer", "size"),
+        "device_step": ("system", "n_steps", "elapsed_s"),
+        "device_flush": ("system", "staged"),
+        "device_compile": ("system", "elapsed_s"),
+        "dropped": ("system", "count"),
+    }
+
+    def __init__(self, capacity: int = 4096):
+        self._buf: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def _record(self, name: str, args) -> None:
+        ev = {"event": name, "ts": time.time()}
+        for field, value in zip(self._FIELDS.get(name, ()), args):
+            ev[field] = value
+        self._append(ev)
+
+    def _append(self, ev: Dict[str, Any]) -> None:
+        with self._lock:
+            self._buf.append(ev)
+
+    def event(self, name: str, **fields: Any) -> None:
+        self._append({"event": name, "ts": time.time(), **fields})
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._buf)
+
+    def of_type(self, name: str) -> List[Dict[str, Any]]:
+        return [e for e in self.events() if e["event"] == name]
+
+
+for _m in InMemoryFlightRecorder._FIELDS:
+    setattr(InMemoryFlightRecorder, _m, _structured(_m))
+
+
+class JsonlFlightRecorder(InMemoryFlightRecorder):
+    """Appends every event as one JSON line (the post-mortem recorder —
+    a human can `jq` the flight after a crash, like opening a .jfr)."""
+
+    def __init__(self, path: str, capacity: int = 4096):
+        super().__init__(capacity)
+        self._path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._fh = open(path, "a", buffering=1)
+        self._flock = threading.Lock()
+
+    def _append(self, ev: Dict[str, Any]) -> None:
+        super()._append(ev)
+        with self._flock:
+            try:
+                self._fh.write(json.dumps(ev) + "\n")
+            except ValueError:  # closed file mid-shutdown
+                pass
+
+    def close(self) -> None:
+        with self._flock:
+            try:
+                self._fh.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def from_config(config) -> FlightRecorder:
+    """`akka.flight-recorder.implementation`: noop (default) | memory | jsonl
+    (+ `akka.flight-recorder.path` for jsonl)."""
+    impl = "noop"
+    path = "flight.jsonl"
+    capacity = 4096
+    if config is not None:
+        impl = config.get_string("akka.flight-recorder.implementation", "noop")
+        path = config.get_string("akka.flight-recorder.path", path)
+        capacity = config.get_int("akka.flight-recorder.capacity", capacity)
+    if impl == "memory":
+        return InMemoryFlightRecorder(capacity)
+    if impl == "jsonl":
+        return JsonlFlightRecorder(path, capacity)
+    return NoOpFlightRecorder()
+
+
+# --------------------------------------------------------- jax.profiler side
+class trace_span:
+    """Context manager: annotate a host-side region so it shows up in a
+    jax.profiler (XProf/TensorBoard) trace alongside the XLA ops it
+    launches. No-ops harmlessly when the profiler isn't active."""
+
+    __slots__ = ("_name", "_cm")
+
+    def __init__(self, name: str):
+        self._name = name
+        self._cm = None
+
+    def __enter__(self):
+        try:
+            import jax.profiler
+            self._cm = jax.profiler.TraceAnnotation(self._name)
+            self._cm.__enter__()
+        except Exception:  # noqa: BLE001 — tracing must never break the step
+            self._cm = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._cm is not None:
+            try:
+                self._cm.__exit__(*exc)
+            except Exception:  # noqa: BLE001
+                pass
+        return False
+
+
+def start_trace(log_dir: str) -> bool:
+    """Begin capturing a device+host profiler trace into log_dir (open with
+    TensorBoard's profile plugin / xprof)."""
+    try:
+        import jax.profiler
+        jax.profiler.start_trace(log_dir)
+        return True
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def stop_trace() -> bool:
+    try:
+        import jax.profiler
+        jax.profiler.stop_trace()
+        return True
+    except Exception:  # noqa: BLE001
+        return False

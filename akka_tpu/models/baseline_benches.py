@@ -40,9 +40,6 @@ def make_fan_in_leaf(n_collectors: int = 1000):
     return fan_in_leaf
 
 
-fan_in_leaf = make_fan_in_leaf(1000)
-
-
 @behavior("collector", {"total": ((), jnp.float32), "msgs": ((), jnp.int32)})
 def fan_in_collector(state, inbox, ctx):
     return ({"total": state["total"] + inbox.sum[0],

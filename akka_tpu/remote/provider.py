@@ -199,6 +199,9 @@ class RemoteActorRefProvider(LocalActorRefProvider):
         bound_host, bound_port = self.transport.listen(host, port, self._inbound)
         self.local_address = Address("akka", self.system_name, bound_host, bound_port)
         self.transport.local_address = f"{bound_host}:{bound_port}"
+        self._flight = getattr(system, "flight_recorder", None)
+        if self._flight is not None:
+            self._flight.transport_started(str(self.local_address))
         # rebase the guardian hierarchy's notion of our address for remote paths
         self.root_path = ActorPath(self.local_address)
         fd_cfg = cfg.get_config("akka.remote.watch-failure-detector")
@@ -238,12 +241,18 @@ class RemoteActorRefProvider(LocalActorRefProvider):
             if a is None:
                 a = Association(key)
                 self._associations[key] = a
+                fr = getattr(self, "_flight", None)
+                if fr is not None:
+                    fr.association_opened(f"{addr.host}:{addr.port}")
             return a
 
     def quarantine(self, address: Address, uid: int) -> None:
         """(reference: Association quarantine :290-314)"""
         self._association(address).quarantine(uid)
         self.event_stream.publish(QuarantinedEvent(address, uid))
+        fr = getattr(self, "_flight", None)
+        if fr is not None:
+            fr.association_quarantined(str(address), f"uid={uid}")
 
     # -- outbound ------------------------------------------------------------
     def remote_send(self, ref: RemoteActorRef, message: Any,
@@ -273,6 +282,14 @@ class RemoteActorRefProvider(LocalActorRefProvider):
                 env.seq = next(assoc.seq)
                 assoc.pending_acks[env.seq] = env
         ok = self.transport.send(addr.host, addr.port, env)
+        fr = getattr(self, "_flight", None)
+        if fr is not None:
+            if ok:
+                fr.remote_message_sent(f"{addr.host}:{addr.port}",
+                                       len(env.payload or b""))
+            else:
+                fr.event("remote_send_failed",
+                         peer=f"{addr.host}:{addr.port}")
         if not ok and not is_system:
             self.dead_letters.tell(DeadLetter(message, sender, ref), sender)
 
@@ -297,6 +314,10 @@ class RemoteActorRefProvider(LocalActorRefProvider):
     # -- inbound -------------------------------------------------------------
     def _inbound(self, env: WireEnvelope) -> None:
         try:
+            fr = getattr(self, "_flight", None)
+            if fr is not None:
+                fr.remote_message_received(env.from_address or "?",
+                                           len(env.payload or b""))
             self._handle_inbound(env)
         except Exception as e:  # noqa: BLE001 — transport thread must survive
             self.event_stream.publish(DeadLetter(f"inbound error: {e!r}", None, None))

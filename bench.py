@@ -106,6 +106,9 @@ def main() -> None:
     ap.add_argument("--config", choices=["ring", "ring-dynamic", "fan-in",
                                          "router", "shard", "latency"],
                     help="run a single config")
+    ap.add_argument("--trace", metavar="DIR",
+                    help="capture a jax.profiler trace of the run into DIR "
+                         "(open with TensorBoard's profile plugin)")
     args = ap.parse_args()
 
     n = args.actors
@@ -124,6 +127,14 @@ def main() -> None:
     dev = jax.devices()[0]
     print(f"[bench] device: {dev.platform}:{dev.device_kind} "
           f"actors={n} steps={steps}", file=sys.stderr)
+
+    if args.trace:
+        from akka_tpu.event.flight_recorder import start_trace
+        if start_trace(args.trace):
+            import atexit
+            from akka_tpu.event.flight_recorder import stop_trace
+            atexit.register(stop_trace)
+            print(f"[bench] tracing to {args.trace}", file=sys.stderr)
 
     extra = {}
 
@@ -152,6 +163,13 @@ def main() -> None:
         "latency": lambda: bench_latency(lat_rounds),
     }
 
+    metric_names = {
+        "ring": "actor.tell() throughput, 1M-actor ring (uniform 1-msg mailbox)",
+        "ring-dynamic": "actor.tell() throughput, 1M-actor ring (dynamic delivery)",
+        "fan-in": "actor.tell() throughput, 1M->1k fan-in",
+        "router": "actor.tell() throughput, RoundRobinPool 100k routees",
+        "shard": "actor.tell() throughput, 256x4k cross-shard",
+    }
     if args.config == "latency":
         out = bench_latency(lat_rounds)
         print(json.dumps({
@@ -161,6 +179,12 @@ def main() -> None:
         return
     if args.config:
         headline = run_one(args.config, configs[args.config])
+        print(json.dumps({
+            "metric": metric_names[args.config], "value": round(headline, 0),
+            "unit": "msgs/sec",
+            "vs_baseline": round(headline / BASELINE_MSGS_PER_SEC, 2),
+            "extra": extra}))
+        return
     else:
         headline = run_one("ring", configs["ring"])
         for name in ("ring-dynamic", "fan-in", "router", "shard", "latency"):
