@@ -484,6 +484,13 @@ class Replicator(Actor):
             return ("l",) + tuple(cls._canon(e) for e in obj)
         if isinstance(obj, (str, int, float, bool, bytes, type(None))):
             return obj
+        from ..actor.ref import ActorRef
+        if isinstance(obj, ActorRef):
+            # by serialized full-address path: the SAME logical ref is a
+            # LocalActorRef on its home node and a RemoteActorRef on peers
+            # — attribute-walking would never hash equal across them
+            from ..serialization.codec import ref_wire_path
+            return ("r", ref_wire_path(obj))
         # CRDTs / VersionVector: class name + attrs, skipping delta caches
         attrs = {}
         for slot in getattr(type(obj), "__slots__", ()) or ():
@@ -499,16 +506,24 @@ class Replicator(Actor):
 
     @classmethod
     def _digest(cls, data: Any) -> bytes:
-        return hashlib.sha1(
-            pickle.dumps(cls._canon(data), protocol=4)).digest()
+        # digest the canonical form with the FIXED wire codec, not pickle:
+        # these bytes are compared across nodes, so the encoding must be
+        # stable across Python versions (pickle's isn't)
+        from ..serialization.codec import dumps as _wire_dumps
+        return hashlib.sha1(_wire_dumps(cls._canon(data))).digest()
 
     def _digest_for(self, key: str) -> bytes:
         """Per-key digest, cached until the next _set_data (the reference
         Replicator caches digests the same way — steady-state gossip must
-        not re-hash the whole data map)."""
+        not re-hash the whole data map). Digests are COMPARED across nodes,
+        so embedded ActorRefs must hash by full-address path — install the
+        transport context like any other wire encode."""
         d = self._digest_cache.get(key)
         if d is None:
-            d = self._digest_cache[key] = self._digest(self.data[key])
+            from ..serialization.serialization import transport_information
+            provider = getattr(self.context.system, "provider", None)
+            with transport_information(provider):
+                d = self._digest_cache[key] = self._digest(self.data[key])
         return d
 
     def _set_data(self, key: str, value: Any, notify: bool = True) -> None:

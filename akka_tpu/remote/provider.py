@@ -180,7 +180,11 @@ class RemoteActorRefProvider(LocalActorRefProvider):
         self.uid = new_uid() + int(time.time() * 1000) % (1 << 20)
         self.transport: Optional[Transport] = None
         self.local_address: Optional[Address] = None
-        self.serialization = Serialization()
+        # pickle on the wire is opt-in only (JavaSerializer-off parity;
+        # default = fixed-schema codecs, serialization/codec.py)
+        self.serialization = Serialization(
+            allow_pickle=settings.config.get_bool(
+                "akka.remote.allow-pickle", False))
         self._associations: Dict[Tuple[str, int], Association] = {}
         self._assoc_lock = threading.Lock()
         self._remote_watcher = None

@@ -15,7 +15,7 @@ lane does (ArteryTransport.scala:383-397).
 
 from __future__ import annotations
 
-import pickle
+
 import queue
 import socket
 import struct
@@ -29,11 +29,23 @@ from ..actor.path import Address
 _LEN = struct.Struct(">I")
 
 
+_ENV_HEAD = struct.Struct(">HBBiqqq")   # magic, version, flags, sid, uid, seq, ack
+_ENV_MAGIC = 0xAF7A
+_ENV_VERSION = 1
+_LANES = ("ordinary", "control", "large")
+
+
 @dataclass
 class WireEnvelope:
     """What crosses the wire (reference: artery Codecs.scala EnvelopeBuffer
     layout — recipient, sender, serializer id, class manifest, payload; plus
-    the system-message seq/ack channel of SystemMessageDelivery.scala)."""
+    the system-message seq/ack channel of SystemMessageDelivery.scala).
+
+    Fixed binary layout — NO pickle at the framing layer:
+      >H magic  >B version  >B flags(bit0 is_system, bits4-5 lane)
+      >i serializer_id  >q from_uid  >q seq(-1=None)  >q ack(-1=None)
+      then length-prefixed UTF-8: recipient, sender(flag bit1 = present),
+      manifest, from_address; length-prefixed payload bytes."""
 
     recipient: str                 # serialization-format path
     sender: Optional[str]
@@ -48,11 +60,51 @@ class WireEnvelope:
     lane: str = "ordinary"         # control | ordinary | large
 
     def to_bytes(self) -> bytes:
-        return pickle.dumps(self, protocol=pickle.HIGHEST_PROTOCOL)
+        flags = (1 if self.is_system else 0) | \
+                (2 if self.sender is not None else 0) | \
+                (_LANES.index(self.lane) << 4)
+        parts = [_ENV_HEAD.pack(
+            _ENV_MAGIC, _ENV_VERSION, flags, self.serializer_id,
+            self.from_uid, -1 if self.seq is None else self.seq,
+            -1 if self.ack is None else self.ack)]
+        for s in (self.recipient, self.sender or "", self.manifest,
+                  self.from_address):
+            b = s.encode("utf-8")
+            parts.append(_LEN.pack(len(b)))
+            parts.append(b)
+        parts.append(_LEN.pack(len(self.payload)))
+        parts.append(self.payload)
+        return b"".join(parts)
 
     @staticmethod
     def from_bytes(data: bytes) -> "WireEnvelope":
-        return pickle.loads(data)
+        magic, version, flags, sid, uid, seq, ack = _ENV_HEAD.unpack_from(data, 0)
+        if magic != _ENV_MAGIC:
+            raise ValueError(f"bad envelope magic 0x{magic:04x}")
+        if version != _ENV_VERSION:
+            raise ValueError(f"unsupported envelope version {version}")
+        off = _ENV_HEAD.size
+        strings = []
+        for _ in range(4):
+            (n,) = _LEN.unpack_from(data, off)
+            off += 4
+            strings.append(data[off:off + n].decode("utf-8"))
+            off += n
+        (n,) = _LEN.unpack_from(data, off)
+        off += 4
+        payload = data[off:off + n]
+        if len(payload) != n:
+            raise ValueError("truncated envelope payload")
+        recipient, sender_s, manifest, from_address = strings
+        return WireEnvelope(
+            recipient=recipient,
+            sender=sender_s if flags & 2 else None,
+            serializer_id=sid, manifest=manifest, payload=payload,
+            is_system=bool(flags & 1),
+            seq=None if seq < 0 else seq,
+            ack=None if ack < 0 else ack,
+            from_address=from_address, from_uid=uid,
+            lane=_LANES[(flags >> 4) & 3])
 
 
 InboundHandler = Callable[[WireEnvelope], None]
@@ -185,7 +237,7 @@ class InProcTransport(Transport):
 
 
 class TcpTransport(Transport):
-    """Framed TCP: 4-byte big-endian length + pickled WireEnvelope. One
+    """Framed TCP: 4-byte big-endian length + binary WireEnvelope. One
     outbound connection per peer, kept open (Artery-tcp-like)."""
 
     def __init__(self, local_address: str = ""):

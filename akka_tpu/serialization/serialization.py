@@ -40,14 +40,29 @@ class Serializer:
 
 
 class PickleSerializer(Serializer):
-    """The default fallback (the reference's JavaSerializer analogue)."""
+    """The reference's JavaSerializer analogue — and like it, OFF on the
+    wire unless explicitly enabled (akka.remote.allow-pickle; reference:
+    allow-java-serialization, off since 2.6). `enabled` is enforced on BOTH
+    directions so a peer can't feed us pickles just because it built some."""
 
     identifier = 1
 
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+
     def to_binary(self, obj: Any) -> bytes:
+        if not self.enabled:
+            raise SerializationError(
+                f"pickle serialization of {type(obj).__name__} is disabled "
+                "(set akka.remote.allow-pickle = true to opt in, or register "
+                "the class with register_wire_class)")
         return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
 
     def from_binary(self, data: bytes, manifest: str = "") -> Any:
+        if not self.enabled:
+            raise SerializationError(
+                "inbound pickle payload refused (akka.remote.allow-pickle "
+                "is off)")
         return pickle.loads(data)
 
 
@@ -109,6 +124,34 @@ class SerializationError(Exception):
     pass
 
 
+class FieldSchemaSerializer(Serializer):
+    """Fixed-schema object graphs (codec.py): tag-coded primitives and
+    containers, raw tensor buffers, ActorRefs as resolved path strings, and
+    allowlisted classes rebuilt via __new__ + setattr — no code execution
+    on decode (the protobuf-internal-serializer analogue,
+    remote/serialization/ + artery Codecs.scala layout discipline)."""
+
+    identifier = 6
+
+    def to_binary(self, obj: Any) -> bytes:
+        from .codec import WireCodecError, dumps
+        try:
+            return dumps(obj)
+        except WireCodecError as e:
+            raise SerializationError(str(e)) from e
+
+    def from_binary(self, data: bytes, manifest: str = "") -> Any:
+        from .codec import WireCodecError, loads
+        try:
+            return loads(data)
+        except WireCodecError as e:
+            raise SerializationError(str(e)) from e
+        except (struct.error, ValueError, TypeError, KeyError, EOFError) as e:
+            # malformed frames must surface as serialization failures, not
+            # leak implementation errors to the inbound path
+            raise SerializationError(f"malformed wire frame: {e!r}") from e
+
+
 # -- ActorRef transparency over the wire -------------------------------------
 # (reference: Serialization.currentTransportInformation thread-local,
 # Serialization.scala:93-136 — refs serialize as full-address path strings and
@@ -159,14 +202,19 @@ def resolve_ref(path: str):
 class Serialization:
     """Per-system registry (reference: Serialization.scala:138)."""
 
-    def __init__(self, system=None):
+    def __init__(self, system=None, allow_pickle: bool = True):
+        """allow_pickle=False is the wire posture (remote provider default):
+        the object fallback becomes the fixed-schema codec, and pickle
+        payloads are refused in both directions."""
         self.system = system
+        self.allow_pickle = allow_pickle
         self._by_id: Dict[int, Serializer] = {}
         self._bindings: list[Tuple[type, Serializer]] = []
         self._cache: Dict[type, Serializer] = {}
         self._lock = threading.Lock()
-        for s in (PickleSerializer(), StringSerializer(), BytesSerializer(),
-                  JsonSerializer(), TensorSerializer()):
+        for s in (PickleSerializer(enabled=allow_pickle), StringSerializer(),
+                  BytesSerializer(), JsonSerializer(), TensorSerializer(),
+                  FieldSchemaSerializer()):
             self.register_serializer(s)
         self.add_binding(str, self._by_id[2])
         self.add_binding(bytes, self._by_id[3])
@@ -176,7 +224,8 @@ class Serialization:
             self.add_binding(jax.Array, self._by_id[5])
         except Exception:  # noqa: BLE001 — jax optional for the host runtime
             pass
-        self.add_binding(object, self._by_id[1])  # fallback
+        # fallback: pickle when explicitly allowed, fixed-schema otherwise
+        self.add_binding(object, self._by_id[1 if allow_pickle else 6])
 
     def register_serializer(self, serializer: Serializer) -> None:
         with self._lock:

@@ -1,0 +1,192 @@
+"""Fixed-schema wire codec (serialization/codec.py) + envelope framing:
+pickle must be OFF on the wire by default and everything internal must
+round-trip without it (reference posture: allow-java-serialization off,
+artery Codecs.scala layout discipline)."""
+
+import enum
+from dataclasses import dataclass
+
+import numpy as np
+import pytest
+
+from akka_tpu.serialization.codec import (WireCodecError, dumps, loads,
+                                          register_wire_class)
+from akka_tpu.serialization.serialization import (SerializationError,
+                                                  Serialization)
+from akka_tpu.remote.transport import WireEnvelope
+
+
+def rt(obj):
+    return loads(dumps(obj))
+
+
+def test_primitive_round_trips():
+    cases = [None, True, False, 0, -1, 42, 1 << 80, -(1 << 90), 3.25,
+             "héllo", b"\x00\xff", [1, "a", None], (1, (2, 3)),
+             {"k": [1, 2]}, {1: 2.5, "s": b"x"}, {1, 2, 3},
+             frozenset({"a"}), [], (), {}]
+    for c in cases:
+        got = rt(c)
+        assert got == c and type(got) is type(c), repr(c)
+
+
+def test_ndarray_round_trip():
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    got = rt(a)
+    np.testing.assert_array_equal(got, a)
+    assert got.dtype == a.dtype
+
+
+def test_framework_dataclass_round_trips_without_registration():
+    from akka_tpu.actor.path import Address
+    a = Address("akka", "sys", "host", 1234)
+    got = rt(a)
+    assert got == a
+
+
+class _ModuleScopedForeign:
+    def __eq__(self, other):
+        return type(other) is type(self)
+
+
+def test_nested_internal_objects():
+    from akka_tpu.cluster.vector_clock import VectorClock
+    v = VectorClock().bump("n1").bump("n2").bump("n1")
+    got = rt(v)
+    assert got.versions == v.versions
+    assert got == v
+
+
+def test_unregistered_external_class_refused():
+    class Local:  # local class, not module scope, not registered
+        pass
+
+    with pytest.raises(WireCodecError):
+        dumps(Local())
+
+
+def test_registered_user_class_round_trips():
+    @register_wire_class
+    @dataclass
+    class Order:
+        sku: str
+        qty: int
+
+    got = rt(Order("tpu", 8))
+    assert got == Order("tpu", 8)
+
+
+def test_enum_round_trip():
+    @register_wire_class
+    class Color(enum.Enum):
+        RED = 1
+        BLUE = 2
+
+    assert rt(Color.BLUE) is Color.BLUE
+
+
+def test_decode_never_runs_init():
+    calls = []
+
+    @register_wire_class
+    class Sneaky:
+        def __init__(self):
+            calls.append("init ran")
+            self.x = 1
+
+    obj = Sneaky()
+    calls.clear()
+    got = rt(obj)
+    assert got.x == 1
+    assert calls == []  # __new__ + setattr only — no constructor execution
+
+
+def test_pickle_refused_by_default_on_wire_registry():
+    s = Serialization(allow_pickle=False)
+
+    class Foreign:
+        pass
+
+    with pytest.raises(SerializationError):
+        s.serialize(Foreign())
+    # inbound direction refused too, even with a valid pickle
+    import pickle
+    with pytest.raises(SerializationError):
+        s.deserialize(1, "", pickle.dumps({"x": 1}))
+
+
+def test_pickle_opt_in_still_works():
+    s = Serialization(allow_pickle=True)
+    sid, manifest, data = s.serialize(_ModuleScopedForeign())
+    assert s.deserialize(sid, manifest, data) == _ModuleScopedForeign()
+
+
+def test_envelope_binary_round_trip():
+    env = WireEnvelope(
+        recipient="akka://sys@h:1/user/a", sender=None, serializer_id=6,
+        manifest="m", payload=b"\x01\x02", is_system=True, seq=7, ack=None,
+        from_address="akka://sys@h:2", from_uid=99, lane="control")
+    got = WireEnvelope.from_bytes(env.to_bytes())
+    assert got == env
+    env2 = WireEnvelope(recipient="r", sender="s", serializer_id=1,
+                        manifest="", payload=b"", lane="large")
+    assert WireEnvelope.from_bytes(env2.to_bytes()) == env2
+
+
+def test_envelope_rejects_garbage():
+    with pytest.raises(ValueError):
+        WireEnvelope.from_bytes(b"\x00" * 64)
+
+
+def test_crdt_round_trip_via_fixed_schema():
+    from akka_tpu.ddata.crdt import GCounter, ORSet
+    s = Serialization(allow_pickle=False)
+    g = GCounter.empty().increment("n1", 5).increment("n2", 2)
+    sid, manifest, data = s.serialize(g)
+    assert sid == 6
+    got = s.deserialize(sid, manifest, data)
+    assert got.value == g.value
+    o = ORSet.empty().add("n1", "a").add("n2", "b")
+    got = s.deserialize(*_rot(s.serialize(o)))
+    assert got.elements == o.elements
+
+
+def _rot(t):
+    return t
+
+
+def test_cyclic_graphs_round_trip():
+    """Self-referential structures (a delta-CRDT whose _delta is itself)
+    must encode via backrefs, not recurse forever."""
+    # dict cycle
+    d = {"name": "root"}
+    d["self"] = d
+    got = rt(d)
+    assert got["self"] is got
+    # list cycle
+    lst = [1]
+    lst.append(lst)
+    got = rt(lst)
+    assert got[1] is got
+    # object whose field is itself (the ORMap._delta shape)
+    from akka_tpu.ddata.crdt import ORMap
+    m = ORMap.empty().put("n1", "k", rt_safe := 7)
+    got = rt(m)
+    assert got.entries == m.entries
+    # shared (non-cyclic) references stay shared
+    inner = {"x": 1}
+    outer = [inner, inner]
+    got = rt(outer)
+    assert got[0] is got[1]
+
+
+def test_replicator_gossip_payload_round_trips():
+    """The exact shape that crossed the wire in the receptionist regression:
+    an ORMultiMap of ServiceKey -> refs with a live delta."""
+    from akka_tpu.ddata.crdt import ORMultiMap
+    m = ORMultiMap.empty().add_binding("n1", "svc", "path-a") \
+                          .add_binding("n2", "svc", "path-b")
+    s = Serialization(allow_pickle=False)
+    sid, manifest, data = s.serialize(m)
+    got = s.deserialize(sid, manifest, data)
+    assert got.get("svc") == m.get("svc")
