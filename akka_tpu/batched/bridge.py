@@ -118,7 +118,7 @@ class BatchedRuntimeHandle:
                  mailbox_slots: int = 0, promise_rows: int = 256,
                  auto_step_interval: float = 0.001,
                  payload_dtype=jnp.float32, event_stream=None,
-                 flight_recorder=None):
+                 flight_recorder=None, failure_policy: str = "restart"):
         self.capacity = capacity
         self.payload_width = payload_width
         self.out_degree = out_degree
@@ -129,6 +129,10 @@ class BatchedRuntimeHandle:
         self.payload_dtype = payload_dtype
         self.event_stream = event_stream
         self.flight_recorder = flight_recorder
+        if failure_policy not in ("restart", "stop", "suspend"):
+            raise ValueError(f"unknown failure_policy {failure_policy!r}")
+        self.failure_policy = failure_policy
+        self._reported_failed: set = set()  # rows already published
         self.default_codec = DefaultCodec(payload_width,
                                           np.dtype(jnp.dtype(payload_dtype)))
 
@@ -486,6 +490,7 @@ class BatchedRuntimeHandle:
                     rt.step()
                     rt.block_until_ready()
                 self._resolve_waiters()
+                self._handle_failures()
                 # a reply may need more device steps (multi-hop): keep
                 # stepping while asks are outstanding
                 if self._waiters:
@@ -521,6 +526,44 @@ class BatchedRuntimeHandle:
                 rt.run(n)
             rt.block_until_ready()
         self._resolve_waiters()
+        self._handle_failures()
+
+    def _handle_failures(self) -> None:
+        """Host-mediated supervision of device error lanes: rows that set
+        `_failed` are restarted with reset state (default), stopped, or
+        left suspended, per failure_policy; each failure is published ONCE
+        (suspended rows keep the flag by design and must not re-report)."""
+        rt = self._runtime
+        if rt is None or "_failed" not in rt.state:
+            return
+        with self._step_lock:
+            rt = self._runtime
+            if not rt.any_failed():  # one device scalar on the hot path
+                if self._reported_failed:
+                    self._reported_failed.clear()
+                return
+            failed = rt.failed_rows()
+            current = set(int(r) for r in failed)
+            new = current - self._reported_failed
+            if self.failure_policy == "restart":
+                rt.restart_rows(failed)
+                self._reported_failed.clear()
+            elif self.failure_policy == "stop":
+                rt.stop_block(failed)
+                rt.clear_failed(failed)  # a dead row must not re-report
+                self._reported_failed.clear()
+            else:  # suspend: flag stays (that IS the suspension)
+                self._reported_failed = current
+        if not new:
+            return
+        new_arr = np.asarray(sorted(new), np.int32)
+        es = self.event_stream
+        if es is not None:
+            es.publish(DeviceActorFailed(new_arr, self.failure_policy))
+        fr = self.flight_recorder
+        if fr is not None and fr.enabled:
+            for r in new_arr[:64]:
+                fr.actor_failed(f"device-row-{int(r)}", "error-lane")
 
     def shutdown(self) -> None:
         self._shutdown = True
@@ -528,6 +571,21 @@ class BatchedRuntimeHandle:
         t = self._pump_thread
         if t is not None:
             t.join(timeout=2.0)
+
+
+class DeviceActorFailed:
+    """EventStream notification: device rows raised their `_failed` error
+    lane and were handled per the handle's failure_policy (host-mediated
+    supervision — FaultHandling.scala parity for the batched runtime)."""
+
+    __slots__ = ("rows", "action")
+
+    def __init__(self, rows, action: str):
+        self.rows = rows
+        self.action = action
+
+    def __repr__(self):
+        return f"DeviceActorFailed(rows={list(self.rows)!r}, action={self.action})"
 
 
 class DroppedDeviceMessages:

@@ -89,6 +89,8 @@ class BatchedSystem:
         self.state: Dict[str, jax.Array] = {
             k: jnp.zeros((n,) + shape, dtype=dtype)
             for k, (shape, dtype) in self.state_spec.items()}
+        if "_become" in self.state:  # re-armed value is -1, not 0
+            self.state["_become"] = jnp.full_like(self.state["_become"], -1)
         self.behavior_id = jnp.zeros((n,), dtype=jnp.int32)
         self.alive = jnp.zeros((n,), dtype=jnp.bool_)
         self.step_count = jnp.asarray(0, jnp.int32)
@@ -171,31 +173,64 @@ class BatchedSystem:
     # ------------------------------------------------------------- lifecycle
     def spawn_block(self, behavior: BatchedBehavior | int, n: int,
                     init_state: Optional[Dict[str, Any]] = None) -> np.ndarray:
-        """Allocate a contiguous block of n actors with the given behavior.
-        Host-side slow path, mirroring the reference's spawn being off the
-        message hot loop. Returns the global ids."""
+        """Allocate n actors with the given behavior. Host-side slow path,
+        mirroring the reference's spawn being off the message hot loop.
+        Fresh capacity is handed out contiguously; once the tail is
+        exhausted, rows freed by stop_block are REUSED (free-list churn —
+        SURVEY.md §7 hard parts: spawn/stop via free-lists). Reused rows
+        get zeroed state and their stale inbox slots scrubbed; note there
+        is no per-row uid, so a tell raced exactly against stop+respawn of
+        the same row can reach the new occupant (the reference guards this
+        with path uids, ActorCell.scala:382-388). Returns the global ids."""
         b_idx = behavior if isinstance(behavior, int) else self.behaviors.index(behavior)
         with self._lock:
             start = self._next_row
-            if start + n > self.capacity:
+            fresh = min(n, self.capacity - start)
+            reused = n - fresh
+            if reused > len(self._free_rows):
                 raise RuntimeError(
-                    f"actor capacity exhausted ({start}+{n} > {self.capacity})")
-            self._next_row = start + n
-        ids = np.arange(start, start + n, dtype=np.int32)
-        sl = slice(start, start + n)
-        self.behavior_id = self.behavior_id.at[sl].set(b_idx)
-        self.alive = self.alive.at[sl].set(True)
+                    f"actor capacity exhausted ({n} requested, "
+                    f"{self.capacity - start} fresh + "
+                    f"{len(self._free_rows)} free)")
+            self._next_row = start + fresh
+            recycled: List[int] = []
+            if reused:
+                recycled = sorted(self._free_rows[-reused:])
+                del self._free_rows[-reused:]
+        ids = np.concatenate([
+            np.arange(start, start + fresh, dtype=np.int32),
+            np.asarray(recycled, dtype=np.int32)]) if reused else \
+            np.arange(start, start + fresh, dtype=np.int32)
+        idx = jnp.asarray(ids)
+        self.behavior_id = self.behavior_id.at[idx].set(b_idx)
+        self.alive = self.alive.at[idx].set(True)
+        if reused:
+            # a recycled row must start life fresh: zero every state column
+            # (reserved cols get their re-arm values) and scrub any stale
+            # in-flight messages addressed to it
+            ridx = jnp.asarray(np.asarray(recycled, np.int32))
+            for col, arr in self.state.items():
+                fill = -1 if col == "_become" else 0
+                self.state[col] = arr.at[ridx].set(
+                    jnp.asarray(fill, arr.dtype))
+            stale = jnp.isin(self.inbox_dst, ridx)
+            self.inbox_valid = jnp.where(stale, False, self.inbox_valid)
         if init_state:
             for col, value in init_state.items():
                 if col not in self.state:
                     raise KeyError(f"unknown state column {col!r}")
-                self.state[col] = self.state[col].at[sl].set(
+                self.state[col] = self.state[col].at[idx].set(
                     jnp.asarray(value, dtype=self.state[col].dtype))
         return ids
 
     def stop_block(self, ids: np.ndarray) -> None:
-        """Mark actors dead (their rows stop updating and emitting)."""
-        self.alive = self.alive.at[jnp.asarray(ids)].set(False)
+        """Mark actors dead and recycle their rows (their rows stop
+        updating and emitting; capacity is reclaimed for future spawns)."""
+        arr = np.unique(np.atleast_1d(np.asarray(ids, np.int32)))
+        self.alive = self.alive.at[jnp.asarray(arr)].set(False)
+        with self._lock:
+            seen = set(self._free_rows)
+            self._free_rows.extend(int(i) for i in arr if int(i) not in seen)
 
     # ------------------------------------------------------------------ tell
     def tell(self, dst, payload, mtype: int = 0) -> None:
@@ -326,7 +361,7 @@ class BatchedSystem:
                    topo_arrays=()):
         n = self.capacity
         nk = n * self.out_degree
-        new_state, emits, dropped = self._core.run_local(
+        new_state, behavior_id, emits, dropped = self._core.run_local(
             state, behavior_id, alive, inbox_dst, inbox_type, inbox_payload,
             inbox_valid, step_count, topo_arrays)
 
@@ -425,6 +460,43 @@ class BatchedSystem:
         # sync via a host read of a non-donated output: on some platforms
         # donated/aliased buffers report ready before the program finishes
         np.asarray(jax.device_get(self.step_count))
+
+    # -------------------------------------------------------- fault handling
+    def any_failed(self) -> bool:
+        """One device scalar — the pump's cheap per-tick check."""
+        from .step import fault_any_failed
+        return fault_any_failed(self.state)
+
+    def failed_rows(self) -> np.ndarray:
+        """Rows whose behavior raised the `_failed` flag (error lanes —
+        suspended until restarted; FaultHandling.scala parity)."""
+        from .step import fault_failed_rows
+        return fault_failed_rows(self.state)
+
+    def restart_rows(self, ids,
+                     init_state: Optional[Dict[str, Any]] = None) -> None:
+        """Host-mediated restart-with-reset-state: zero the rows' state
+        (reserved columns re-armed), clear the failure flag, keep the
+        behavior (preRestart/postRestart with a fresh instance —
+        ActorCell.scala:589-602 faultRecreate analogue)."""
+        from .step import fault_restart_rows
+        self.state = fault_restart_rows(self.state, ids, init_state)
+
+    def clear_failed(self, ids) -> None:
+        from .step import fault_clear_failed
+        self.state = fault_clear_failed(self.state, ids)
+
+    def set_behavior(self, ids, behavior: BatchedBehavior | int) -> None:
+        """Host-side become: rewrite the rows' behavior index."""
+        b_idx = behavior if isinstance(behavior, int) \
+            else self.behaviors.index(behavior)
+        idx = jnp.asarray(np.atleast_1d(np.asarray(ids, np.int32)))
+        self.behavior_id = self.behavior_id.at[idx].set(b_idx)
+
+    @property
+    def free_row_count(self) -> int:
+        with self._lock:
+            return len(self._free_rows) + (self.capacity - self._next_row)
 
     # ------------------------------------------------------------------ read
     def read_state(self, col: str, ids: Optional[np.ndarray] = None) -> np.ndarray:

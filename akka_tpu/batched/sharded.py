@@ -78,6 +78,9 @@ class ShardedBatchedSystem:
         n = self.capacity
         self.state = {k: jax.device_put(jnp.zeros((n,) + shape, dtype=dtype), shard)
                       for k, (shape, dtype) in self.state_spec.items()}
+        if "_become" in self.state:  # re-armed value is -1, not 0
+            self.state["_become"] = jax.device_put(
+                jnp.full((n,), -1, self.state_spec["_become"][1]), shard)
         self.behavior_id = jax.device_put(jnp.zeros((n,), jnp.int32), shard)
         self.alive = jax.device_put(jnp.zeros((n,), jnp.bool_), shard)
         self.step_count = jnp.asarray(0, jnp.int32)
@@ -121,7 +124,7 @@ class ShardedBatchedSystem:
             shard_idx = jax.lax.axis_index(axis)
             base = shard_idx * n_local
 
-            new_state, emits, mdrop = core.run_local(
+            new_state, behavior_id, emits, mdrop = core.run_local(
                 state, behavior_id, alive, inbox_dst, inbox_type,
                 inbox_payload, inbox_valid, step_count,
                 dst_offset=base, id_base=base)
@@ -295,6 +298,31 @@ class ShardedBatchedSystem:
         if ids is not None:
             arr = arr[jnp.asarray(ids)]
         return np.asarray(jax.device_get(arr))
+
+    def any_failed(self) -> bool:
+        from .step import fault_any_failed
+        return fault_any_failed(self.state)
+
+    def failed_rows(self) -> np.ndarray:
+        """Rows whose behavior raised the `_failed` error lane."""
+        from .step import fault_failed_rows
+        return fault_failed_rows(self.state)
+
+    def restart_rows(self, ids,
+                     init_state: Optional[Dict[str, Any]] = None) -> None:
+        """Host-mediated restart-with-reset-state (see BatchedSystem)."""
+        from .step import fault_restart_rows
+        self.state = fault_restart_rows(self.state, ids, init_state)
+
+    def clear_failed(self, ids) -> None:
+        from .step import fault_clear_failed
+        self.state = fault_clear_failed(self.state, ids)
+
+    def stop_block(self, ids) -> None:
+        """Mark rows dead (no free-list on the sharded runtime: spawn is
+        contiguous; rebalancing owns row placement)."""
+        arr = np.unique(np.atleast_1d(np.asarray(ids, np.int32)))
+        self.alive = self.alive.at[jnp.asarray(arr)].set(False)
 
     @property
     def total_dropped(self) -> int:

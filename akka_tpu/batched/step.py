@@ -178,30 +178,56 @@ class StepCore:
         def per_actor(state_row, b_id, alive_i, gid, *inbox_parts):
             inbox = make_inbox(*inbox_parts)
             ctx = Ctx(actor_id=gid, step=step_count, n_actors=n_global)
+            # an already-failed row is suspended: no update, no emissions,
+            # until the host restarts it (FaultHandling.suspend parity —
+            # actor/dungeon/FaultHandling.scala; messages arriving while
+            # suspended are dropped, unlike the reference's queued mailbox)
+            was_failed = state_row.get("_failed", jnp.asarray(False))
+            live = alive_i & ~was_failed
             new_state, emit = jax.lax.switch(b_id, branches, state_row,
                                              inbox, ctx)
-            new_state = jax.tree.map(
-                lambda new, old: jnp.where(_bshape(alive_i, new), new, old),
+            # a row FAILING THIS STEP keeps its pre-failure state (the
+            # aborted receive must not half-apply) and emits nothing; only
+            # the flag itself sticks (handleInvokeFailure: the failing
+            # message's effects are discarded, the failure is recorded)
+            now_failed = new_state.get("_failed", jnp.asarray(False))
+            apply = live & ~now_failed
+            merged = jax.tree.map(
+                lambda new, old: jnp.where(_bshape(apply, new), new, old),
                 new_state, state_row)
-            emit = Emit(dst=jnp.where(alive_i, emit.dst, -1),
+            if "_failed" in merged:
+                merged["_failed"] = jnp.where(live, now_failed, was_failed)
+            emit = Emit(dst=jnp.where(apply, emit.dst, -1),
                         payload=emit.payload,
-                        valid=emit.valid & alive_i,
+                        valid=emit.valid & apply,
                         type=emit.type)
-            return new_state, emit
+            return merged, emit
 
-        return jax.vmap(per_actor)(state, behavior_id, alive, ids,
-                                   *per_actor_inbox)
+        new_state, emits = jax.vmap(per_actor)(state, behavior_id, alive,
+                                               ids, *per_actor_inbox)
+        # device-side become (ActorCell.become :589-602): behaviors write
+        # the target behavior index into the reserved `_become` column; the
+        # runtime applies it and re-arms the column to -1
+        if "_become" in new_state:
+            req = new_state["_become"]
+            new_behavior_id = jnp.where(req >= 0, req.astype(jnp.int32),
+                                        behavior_id)
+            new_state = dict(new_state)
+            new_state["_become"] = jnp.full_like(req, -1)
+        else:
+            new_behavior_id = behavior_id
+        return new_state, new_behavior_id, emits
 
     def run_local(self, state, behavior_id, alive, inbox_dst, inbox_type,
                   inbox_payload, inbox_valid, step_count, topo_arrays=(),
                   dst_offset=None, id_base=0):
-        """deliver + update in one call. Returns (new_state, emits, dropped)
-        where dropped is this step's mailbox-overflow count (0 in reduce
-        mode — reductions never overflow)."""
+        """deliver + update in one call. Returns (new_state, new_behavior_id,
+        emits, dropped) where dropped is this step's mailbox-overflow count
+        (0 in reduce mode — reductions never overflow)."""
         d = self.deliver(inbox_dst, inbox_type, inbox_payload, inbox_valid,
                          topo_arrays, dst_offset)
-        new_state, emits = self.update(state, behavior_id, alive, d,
-                                       step_count, id_base)
+        new_state, new_behavior_id, emits = self.update(
+            state, behavior_id, alive, d, step_count, id_base)
         if self.slots > 0:
             # per-recipient overflow, masked to slots-kind recipients
             over = jnp.maximum(d.count - self.slots, 0)
@@ -209,4 +235,54 @@ class StepCore:
                                         over, 0)).astype(jnp.int32)
         else:
             dropped = jnp.asarray(0, jnp.int32)
-        return new_state, emits, dropped
+        return new_state, new_behavior_id, emits, dropped
+
+
+# -------------------------------------------------- shared fault handling
+# Host-side error-lane helpers used by BOTH BatchedSystem and
+# ShardedBatchedSystem (the same dedup role StepCore plays for the step).
+
+def fault_any_failed(state) -> bool:
+    """Cheap check: ONE device scalar, not the whole column — the pump
+    calls this every tick."""
+    if "_failed" not in state:
+        return False
+    import jax as _jax
+    return bool(_jax.device_get(jnp.any(state["_failed"])))
+
+
+def fault_failed_rows(state):
+    import numpy as _np
+    import jax as _jax
+    if "_failed" not in state:
+        return _np.empty((0,), _np.int32)
+    flags = _np.asarray(_jax.device_get(state["_failed"]))
+    return _np.nonzero(flags)[0].astype(_np.int32)
+
+
+def fault_restart_rows(state, ids, init_state=None):
+    """Restart-with-reset-state: zero the rows' columns (reserved columns
+    re-armed), returning the new state dict. Mutates nothing."""
+    import numpy as _np
+    idx = jnp.asarray(_np.atleast_1d(_np.asarray(ids, _np.int32)))
+    out = dict(state)
+    for col, arr in out.items():
+        fill = -1 if col == "_become" else 0
+        out[col] = arr.at[idx].set(jnp.asarray(fill, arr.dtype))
+    if init_state:
+        for col, value in init_state.items():
+            out[col] = out[col].at[idx].set(
+                jnp.asarray(value, out[col].dtype))
+    return out
+
+
+def fault_clear_failed(state, ids):
+    """Clear only the failure flag (used by the 'stop' policy so a dead
+    row stops re-reporting)."""
+    import numpy as _np
+    if "_failed" not in state:
+        return state
+    idx = jnp.asarray(_np.atleast_1d(_np.asarray(ids, _np.int32)))
+    out = dict(state)
+    out["_failed"] = out["_failed"].at[idx].set(False)
+    return out

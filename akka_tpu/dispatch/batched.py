@@ -54,6 +54,7 @@ class TpuBatchedDispatcher(Dispatcher):
                         "auto-step-interval", "1ms"),
                     event_stream=getattr(system, "event_stream", None),
                     flight_recorder=getattr(system, "flight_recorder", None),
+                    failure_policy=c.get_string("failure-policy", "restart"),
                 )
             return self._handle
 

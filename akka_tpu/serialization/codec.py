@@ -120,17 +120,20 @@ def _r_str(inp: io.BytesIO) -> str:
 
 
 def _is_cycle_kind(obj: Any) -> bool:
-    """True for kinds that get a memo slot (list/set/dict/object) — the only
-    kinds whose decode can materialize before their children, and therefore
-    the only kinds that can legally participate in cycles."""
+    """True for kinds that get a memo slot (exact list/set/dict + O-coded
+    objects) — encode and decode MUST register the same kinds in the same
+    order or every later backref is misaligned (silent corruption). The
+    isinstance checks therefore mirror the encode dispatch exactly:
+    NamedTuples (tuple subclasses, 'n'-coded) and refused builtin
+    subclasses never take a slot."""
     t = type(obj)
     if t in (list, set, dict):
         return True
-    if obj is None or t in (bool, int, float, str, bytes, tuple, frozenset):
+    if obj is None or isinstance(
+            obj, (bool, int, float, str, bytes, tuple, frozenset, list, set,
+                  dict, np.ndarray, np.generic, enum.Enum)):
         return False
-    if isinstance(obj, (np.ndarray, np.generic)) or t.__name__ == "ArrayImpl":
-        return False
-    if isinstance(obj, enum.Enum) or _is_actor_ref(obj):
+    if t.__name__ == "ArrayImpl" or _is_actor_ref(obj):
         return False
     return True
 

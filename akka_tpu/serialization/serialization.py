@@ -146,7 +146,8 @@ class FieldSchemaSerializer(Serializer):
             return loads(data)
         except WireCodecError as e:
             raise SerializationError(str(e)) from e
-        except (struct.error, ValueError, TypeError, KeyError, EOFError) as e:
+        except (struct.error, ValueError, TypeError, KeyError, EOFError,
+                AttributeError) as e:
             # malformed frames must surface as serialization failures, not
             # leak implementation errors to the inbound path
             raise SerializationError(f"malformed wire frame: {e!r}") from e

@@ -180,6 +180,32 @@ def test_cyclic_graphs_round_trip():
     assert got[0] is got[1]
 
 
+def test_namedtuple_and_backrefs_stay_aligned():
+    """Regression: NamedTuples must NOT consume a memo slot (decode never
+    registers them) or every later backref shifts — silent corruption."""
+    from akka_tpu.ops.segment import Delivery
+    import jax.numpy as jnp
+    d = {"x": 1}
+    deliv = Delivery(sum=np.zeros((2, 1), np.float32),
+                     max=np.zeros((2, 1), np.float32),
+                     count=np.zeros((2,), np.int32))
+    got = rt([deliv, d, d, {"y": 2}, d])
+    assert got[1] is got[2] and got[2] is got[4]
+    assert got[3] == {"y": 2}
+    np.testing.assert_array_equal(got[0].count, deliv.count)
+    # repeated NamedTuple instances also decode fine (re-encoded by value)
+    got = rt([deliv, deliv])
+    np.testing.assert_array_equal(got[1].sum, deliv.sum)
+
+
+def test_builtin_subclass_refused():
+    class FancyList(list):
+        pass
+    register_wire_class(FancyList)
+    with pytest.raises(WireCodecError):
+        dumps(FancyList([1, 2]))
+
+
 def test_replicator_gossip_payload_round_trips():
     """The exact shape that crossed the wire in the receptionist regression:
     an ORMultiMap of ServiceKey -> refs with a live delta."""
