@@ -124,6 +124,9 @@ class Ctx(NamedTuple):
     actor_id: jax.Array  # [] int32 — this actor's global id
     step: jax.Array      # [] int32 — global step counter
     n_actors: jax.Array  # [] int32 — capacity of the actor space
+    tables: Any = ()     # runtime lookup tables (dict of small arrays,
+                         # NOT vmapped — e.g. the device-sharding
+                         # logical-shard -> row-base placement table)
 
 
 @dataclass

@@ -133,6 +133,11 @@ class BatchedRuntimeHandle:
             raise ValueError(f"unknown failure_policy {failure_policy!r}")
         self.failure_policy = failure_policy
         self._reported_failed: set = set()  # rows already published
+        # (rows, init_state) per spawn: a restart must re-apply the
+        # spawn-time init (Props re-instantiation parity), not reset to
+        # zeros. Rows are stored explicitly — free-list reuse makes spawn
+        # results non-contiguous.
+        self._spawn_inits: List[Tuple[np.ndarray, Dict[str, Any]]] = []
         self.default_codec = DefaultCodec(payload_width,
                                           np.dtype(jnp.dtype(payload_dtype)))
 
@@ -198,8 +203,12 @@ class BatchedRuntimeHandle:
             self._behavior_index(b)
             if self._runtime is not None:
                 with self._step_lock:  # slab writes must not race a step
-                    return self._runtime.spawn_block(
+                    rows = self._runtime.spawn_block(
                         self._behaviors.index(b), n, init_state)
+                if init_state:
+                    self._spawn_inits.append(
+                        (np.asarray(rows, np.int32), dict(init_state)))
+                return rows
             # pre-build: the top promise_rows_n rows are reserved for ask()
             if self._next_row + n > self.capacity - self.promise_rows_n:
                 raise RuntimeError("device actor capacity exhausted")
@@ -207,6 +216,8 @@ class BatchedRuntimeHandle:
                              dtype=np.int32)
             self._next_row += n
             self._spawns.append(_SpawnRecord(b, n, init_state, rows))
+            if init_state:
+                self._spawn_inits.append((rows.copy(), dict(init_state)))
             return rows
 
     def stop_rows(self, rows) -> None:
@@ -547,6 +558,15 @@ class BatchedRuntimeHandle:
             new = current - self._reported_failed
             if self.failure_policy == "restart":
                 rt.restart_rows(failed)
+                # restore spawn-time init values for the restarted rows
+                # (an Akka restart re-instantiates from Props)
+                for rows, init in self._spawn_inits:
+                    hit = failed[np.isin(failed, rows)]
+                    if hit.size:
+                        for col, value in init.items():
+                            rt.state[col] = rt.state[col].at[
+                                jnp.asarray(hit)].set(
+                                jnp.asarray(value, rt.state[col].dtype))
                 self._reported_failed.clear()
             elif self.failure_policy == "stop":
                 rt.stop_block(failed)

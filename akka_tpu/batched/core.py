@@ -207,14 +207,28 @@ class BatchedSystem:
         if reused:
             # a recycled row must start life fresh: zero every state column
             # (reserved cols get their re-arm values) and scrub any stale
-            # in-flight messages addressed to it
-            ridx = jnp.asarray(np.asarray(recycled, np.int32))
+            # in-flight messages addressed to it — BOTH the device inbox
+            # and the not-yet-flushed host staging queues (a tell staged
+            # against the old occupant must never reach the new one)
+            rec_arr = np.asarray(recycled, np.int32)
+            ridx = jnp.asarray(rec_arr)
             for col, arr in self.state.items():
                 fill = -1 if col == "_become" else 0
                 self.state[col] = arr.at[ridx].set(
                     jnp.asarray(fill, arr.dtype))
             stale = jnp.isin(self.inbox_dst, ridx)
             self.inbox_valid = jnp.where(stale, False, self.inbox_valid)
+            if self._stager is not None:
+                d, r = self._stager.drain()
+                if d.shape[0]:
+                    keep = ~np.isin(d, rec_arr)
+                    if keep.any():
+                        self._stager.stage(np.ascontiguousarray(d[keep]),
+                                           np.ascontiguousarray(r[keep]))
+            with self._lock:
+                rec_set = set(int(i) for i in rec_arr)
+                self._host_staged = [e for e in self._host_staged
+                                     if e[0] not in rec_set]
         if init_state:
             for col, value in init_state.items():
                 if col not in self.state:

@@ -47,7 +47,7 @@ class ShardedBatchedSystem:
                  host_inbox_per_shard: int = 256,
                  remote_capacity_per_pair: Optional[int] = None,
                  payload_dtype=jnp.float32, axis_name: str = "shards",
-                 mailbox_slots: int = 0):
+                 mailbox_slots: int = 0, reroute_strays: bool = False):
         self.mesh = mesh if mesh is not None else make_mesh(n_devices, axis_name)
         self.axis = axis_name
         self.n_shards = self.mesh.shape[axis_name]
@@ -63,9 +63,19 @@ class ShardedBatchedSystem:
         self.mailbox_slots = int(mailbox_slots)
         if self.mailbox_slots == 0 and any(b.inbox == "slots" for b in behaviors):
             self.mailbox_slots = max(2, out_degree)
-        # lossless default: every local emission could target a single shard
-        self.pair_cap = (remote_capacity_per_pair if remote_capacity_per_pair
-                         else self.local_n * out_degree)
+        # forward inbox messages whose home shard moved (rebalance) one
+        # more hop instead of dropping them; costs a larger bucketing sort
+        self.reroute_strays = bool(reroute_strays)
+        # lossless default: every local emission could target a single
+        # shard; with stray rerouting, one rebalanced block's worth of
+        # forwarded in-flight messages can ride alongside a full emission
+        # batch, so the default doubles (overflow is still counted either
+        # way — `dropped` is the guard, this is the sizing heuristic)
+        if remote_capacity_per_pair:
+            self.pair_cap = remote_capacity_per_pair
+        else:
+            self.pair_cap = self.local_n * out_degree * \
+                (2 if reroute_strays else 1)
 
         self.state_spec: Dict[str, Tuple[Tuple[int, ...], Any]] = {}
         for b in self.behaviors:
@@ -100,6 +110,10 @@ class ShardedBatchedSystem:
         self._next_row = 0
         self._lock = threading.Lock()
         self._host_staged: List[Tuple[int, int, np.ndarray]] = []
+        # small replicated lookup tables exposed to behaviors via
+        # ctx.tables (e.g. device-sharding placement). Set BEFORE first
+        # run; keys are fixed per built step function.
+        self.tables: Dict[str, jax.Array] = {}
 
         self._core = StepCore(self.behaviors, n_local=self.local_n,
                               payload_width=payload_width,
@@ -107,7 +121,7 @@ class ShardedBatchedSystem:
                               payload_dtype=payload_dtype,
                               slots=self.mailbox_slots,
                               n_global=self.capacity)
-        self._step_fn = self._build_step()
+        self._step_fn = None  # built lazily: tables may be set post-init
 
     # -------------------------------------------------------------- builders
     def _build_step(self):
@@ -119,7 +133,7 @@ class ShardedBatchedSystem:
 
         def local_step(state, behavior_id, alive, inbox_dst, inbox_type,
                        inbox_payload, inbox_valid, dropped, mail_dropped,
-                       step_count):
+                       step_count, tables):
             # shapes here are per-shard blocks
             shard_idx = jax.lax.axis_index(axis)
             base = shard_idx * n_local
@@ -127,7 +141,7 @@ class ShardedBatchedSystem:
             new_state, behavior_id, emits, mdrop = core.run_local(
                 state, behavior_id, alive, inbox_dst, inbox_type,
                 inbox_payload, inbox_valid, step_count,
-                dst_offset=base, id_base=base)
+                dst_offset=base, id_base=base, tables=tables)
 
             # ---- route: bucket by destination shard, exchange over ICI ----
             # ONE stable keyed sort carries every column through the sort
@@ -139,6 +153,19 @@ class ShardedBatchedSystem:
             out_payload = emits.payload.reshape(-1, p_w)
             out_type = emits.type.reshape(-1)
             out_valid = emits.valid.reshape(-1) & (out_dst >= 0) & (out_dst < n_global)
+            if self.reroute_strays:
+                # inbox rows addressed OUTSIDE this shard (a shard was
+                # rebalanced after the message was exchanged): forward them
+                # one more hop instead of dropping — ShardRegion buffering-
+                # during-handoff semantics (ShardRegion.scala:968,1056).
+                # Strays ride FIRST (they are older; the sort is stable).
+                stray_ok = inbox_valid & (inbox_dst >= 0) & \
+                    ((inbox_dst < base) | (inbox_dst >= base + n_local))
+                out_dst = jnp.concatenate([
+                    jnp.where(stray_ok, inbox_dst, -1), out_dst])
+                out_payload = jnp.concatenate([inbox_payload, out_payload])
+                out_type = jnp.concatenate([inbox_type, out_type])
+                out_valid = jnp.concatenate([stray_ok, out_valid])
             dest_shard = jnp.where(out_valid, out_dst // n_local, n_shards)
 
             m = out_dst.shape[0]
@@ -206,25 +233,27 @@ class ShardedBatchedSystem:
 
         mesh = self.mesh
         state_specs = {k: P(axis) for k in self.state_spec}
+        table_specs = {k: P() for k in self.tables}  # replicated, tiny
         in_specs = (state_specs, P(axis), P(axis), P(axis), P(axis), P(axis),
-                    P(axis), P(axis), P(axis), P())
-        out_specs = in_specs
+                    P(axis), P(axis), P(axis), P(), table_specs)
+        out_specs = (state_specs, P(axis), P(axis), P(axis), P(axis), P(axis),
+                     P(axis), P(axis), P(axis), P())
 
         sharded = shard_map(local_step, mesh=mesh, in_specs=in_specs,
                             out_specs=out_specs, check_vma=False)
 
         def multi_step(state, behavior_id, alive, inbox_dst, inbox_type,
                        inbox_payload, inbox_valid, dropped, mail_dropped,
-                       step_count, n_steps: int):
+                       step_count, tables, n_steps: int):
             def body(carry, _):
-                return sharded(*carry), None
+                return sharded(*carry, tables), None
             carry = (state, behavior_id, alive, inbox_dst, inbox_type,
                      inbox_payload, inbox_valid, dropped, mail_dropped,
                      step_count)
             carry, _ = jax.lax.scan(body, carry, None, length=n_steps)
             return carry
 
-        return jax.jit(multi_step, static_argnums=(10,),
+        return jax.jit(multi_step, static_argnums=(11,),
                        donate_argnums=tuple(range(9)))
 
     # ------------------------------------------------------------- lifecycle
@@ -280,8 +309,19 @@ class ShardedBatchedSystem:
             jnp.asarray(np.stack(pls), self.payload_dtype))
         self.inbox_valid = self.inbox_valid.at[idx].set(True)
 
+    def set_tables(self, tables: Dict[str, Any]) -> None:
+        """Install/replace the replicated lookup tables behaviors see via
+        ctx.tables. Changing the KEY SET after the first run retraces the
+        step program; changing only the values does not."""
+        rebuild = set(tables) != set(self.tables) and self._step_fn is not None
+        self.tables = {k: jnp.asarray(v) for k, v in tables.items()}
+        if rebuild:
+            self._step_fn = self._build_step()
+
     # ------------------------------------------------------------------ step
     def run(self, n_steps: int = 1) -> None:
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
         self._flush_staged()
         (self.state, self.behavior_id, self.alive, self.inbox_dst,
          self.inbox_type, self.inbox_payload, self.inbox_valid, self.dropped,
@@ -289,7 +329,7 @@ class ShardedBatchedSystem:
             self._step_fn(self.state, self.behavior_id, self.alive,
                           self.inbox_dst, self.inbox_type, self.inbox_payload,
                           self.inbox_valid, self.dropped, self.mail_dropped,
-                          self.step_count, n_steps)
+                          self.step_count, self.tables, n_steps)
 
     step = run
 

@@ -151,7 +151,7 @@ class StepCore:
 
     # -------------------------------------------------------------- update
     def update(self, state, behavior_id, alive, delivered, step_count,
-               id_base=0):
+               id_base=0, tables=()):
         """Vmapped behavior switch over all local rows. Returns
         (new_state, emits) with emits shaped [n_local, K(...)]. Dead rows
         neither update nor emit."""
@@ -177,7 +177,10 @@ class StepCore:
 
         def per_actor(state_row, b_id, alive_i, gid, *inbox_parts):
             inbox = make_inbox(*inbox_parts)
-            ctx = Ctx(actor_id=gid, step=step_count, n_actors=n_global)
+            # `tables` is closed over, not vmapped: every lane sees the
+            # same small lookup arrays (placement tables etc.)
+            ctx = Ctx(actor_id=gid, step=step_count, n_actors=n_global,
+                      tables=tables)
             # an already-failed row is suspended: no update, no emissions,
             # until the host restarts it (FaultHandling.suspend parity —
             # actor/dungeon/FaultHandling.scala; messages arriving while
@@ -220,14 +223,14 @@ class StepCore:
 
     def run_local(self, state, behavior_id, alive, inbox_dst, inbox_type,
                   inbox_payload, inbox_valid, step_count, topo_arrays=(),
-                  dst_offset=None, id_base=0):
+                  dst_offset=None, id_base=0, tables=()):
         """deliver + update in one call. Returns (new_state, new_behavior_id,
         emits, dropped) where dropped is this step's mailbox-overflow count
         (0 in reduce mode — reductions never overflow)."""
         d = self.deliver(inbox_dst, inbox_type, inbox_payload, inbox_valid,
                          topo_arrays, dst_offset)
         new_state, new_behavior_id, emits = self.update(
-            state, behavior_id, alive, d, step_count, id_base)
+            state, behavior_id, alive, d, step_count, id_base, tables)
         if self.slots > 0:
             # per-recipient overflow, masked to slots-kind recipients
             over = jnp.maximum(d.count - self.slots, 0)

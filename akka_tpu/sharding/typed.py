@@ -87,6 +87,19 @@ class ClusterShardingTyped:
             extract_entity_id=entity.extract_entity_id,
             extract_shard_id=entity.extract_shard_id)
 
+    def init_device(self, spec, mesh=None):
+        """Device-backed entity type: entities become rows in a
+        ShardedBatchedSystem on the mesh (see sharding/device.py —
+        the ClusterSharding.init analogue for BatchedBehavior entities)."""
+        from .device import DeviceShardRegion
+        region = DeviceShardRegion(spec, mesh=mesh)
+        self._device_regions = getattr(self, "_device_regions", {})
+        self._device_regions[spec.type_name] = region
+        return region
+
+    def device_region(self, type_name: str):
+        return getattr(self, "_device_regions", {})[type_name]
+
     def entity_ref_for(self, type_key: EntityTypeKey,
                        entity_id: str) -> EntityRef:
         region = self._classic.shard_region(type_key.name)

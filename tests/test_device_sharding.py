@@ -1,0 +1,178 @@
+"""Device-backed cluster sharding: entities→shards→device rows with
+coordinator placement, rebalance as slab copy, cross-shard tells as
+all_to_all (VERDICT r1 item 4; reference: ShardRegion.scala:1046,
+ShardCoordinator.scala:90-201). Runs on the virtual 8-device CPU mesh."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from akka_tpu.batched import Emit, behavior
+from akka_tpu.sharding.device import DeviceEntity, DeviceShardRegion
+
+P = 4
+
+
+@behavior("dev-counter", {"n": ((), jnp.int32)})
+def dev_counter(state, inbox, ctx):
+    return ({"n": state["n"] + inbox.count}, Emit.none(1, P))
+
+
+def make_forwarder(eps: int, n_shards: int):
+    """Entity that forwards its token to the SAME index in the NEXT logical
+    shard, resolved through the live placement table — messages follow a
+    rebalanced shard wherever it moves."""
+
+    @behavior("dev-fwd", {"received": ((), jnp.int32),
+                          "myshard": ((), jnp.int32),
+                          "myidx": ((), jnp.int32)})
+    def fwd(state, inbox, ctx):
+        base = ctx.tables["shard_row_base"]
+        nxt_shard = (state["myshard"] + 1) % n_shards
+        dst = base[nxt_shard] + state["myidx"]
+        return ({"received": state["received"] + inbox.count,
+                 "myshard": state["myshard"], "myidx": state["myidx"]},
+                Emit.single(dst, inbox.sum, 1, P, when=inbox.count > 0))
+
+    return fwd
+
+
+def test_entity_allocation_and_tell():
+    spec = DeviceEntity("counters", dev_counter, n_shards=8,
+                        entities_per_shard=16, payload_width=P)
+    region = DeviceShardRegion(spec)
+    a = region.entity_ref("alice")
+    b = region.entity_ref("bob")
+    assert region.entity_ref("alice").row == a.row  # stable resolution
+    a.tell([1.0, 0, 0, 0])
+    a.tell([1.0, 0, 0, 0])
+    b.tell([1.0, 0, 0, 0])
+    region.run(1)
+    region.block_until_ready()
+    assert a.read_state("n") == 2
+    assert b.read_state("n") == 1
+    st = region.stats()
+    assert st["entities"] >= 2 and st["shards"] == 8
+
+
+def test_shards_spread_over_devices():
+    spec = DeviceEntity("spread", dev_counter, n_shards=16,
+                        entities_per_shard=8, n_devices=8, payload_width=P)
+    region = DeviceShardRegion(spec)
+    devs = {region.device_of_shard(s) for s in range(16)}
+    assert devs == set(range(8))  # round-robin striping covers the mesh
+
+
+def test_cross_shard_ring_under_sharding_api():
+    n_shards, eps = 16, 8
+    fwd = make_forwarder(eps, n_shards)
+    spec = DeviceEntity("ring", fwd, n_shards=n_shards,
+                        entities_per_shard=eps, n_devices=8, payload_width=P)
+    region = DeviceShardRegion(spec)
+    region.allocate_all()
+    sys = region.system
+    # init identity columns + seed one token per entity
+    myshard = np.zeros((sys.capacity,), np.int32)
+    myidx = np.zeros((sys.capacity,), np.int32)
+    for s in range(n_shards):
+        base = region.row_of(s, 0)
+        myshard[base:base + eps] = s
+        myidx[base:base + eps] = np.arange(eps)
+    sys.state["myshard"] = sys.state["myshard"].at[:].set(jnp.asarray(myshard))
+    sys.state["myidx"] = sys.state["myidx"].at[:].set(jnp.asarray(myidx))
+    for s in range(n_shards):
+        for i in range(eps):
+            sys.tell(region.row_of(s, i), [1.0, 0, 0, 0])
+    steps = 5
+    region.run(steps)
+    region.block_until_ready()
+    recv = sys.read_state("received")
+    live = np.asarray(sys.alive)
+    assert (recv[live] == steps).all()
+    assert sys.total_dropped == 0
+
+
+def test_rebalance_moves_state_and_messages():
+    n_shards, eps = 8, 8
+    fwd = make_forwarder(eps, n_shards)
+    spec = DeviceEntity("reb", fwd, n_shards=n_shards, entities_per_shard=eps,
+                        n_devices=8, payload_width=P)
+    region = DeviceShardRegion(spec)
+    region.allocate_all()
+    sys = region.system
+    myshard = np.zeros((sys.capacity,), np.int32)
+    myidx = np.zeros((sys.capacity,), np.int32)
+    for s in range(n_shards):
+        base = region.row_of(s, 0)
+        myshard[base:base + eps] = s
+        myidx[base:base + eps] = np.arange(eps)
+    sys.state["myshard"] = sys.state["myshard"].at[:].set(jnp.asarray(myshard))
+    sys.state["myidx"] = sys.state["myidx"].at[:].set(jnp.asarray(myidx))
+    for s in range(n_shards):
+        for i in range(eps):
+            sys.tell(region.row_of(s, i), [1.0, 0, 0, 0])
+    region.run(2)
+    region.block_until_ready()
+
+    # move shard 3 to a spare block (possibly another device) MID-RUN
+    old_dev = region.device_of_shard(3)
+    region.rebalance(3)
+    moved_dev = region.device_of_shard(3)
+    region.run(3)
+    region.block_until_ready()
+
+    # No token is ever lost: state followed the move and in-flight messages
+    # were re-pointed + forwarded. Accounting: (a) tokens mid-flight toward
+    # the moved shard spend one step being forwarded — eps deliveries shift
+    # out of the 5-step window; (b) the delayed batch then arrives at the
+    # moved shard TOGETHER with the next batch, and a reduce-mode inbox
+    # merges them into one delivery (counts sum) — another eps. Every
+    # entity still lands within one delivery of nominal and nothing drops.
+    total = 0
+    for s in range(n_shards):
+        base = region.row_of(s, 0)
+        recv = sys.read_state("received",
+                              np.arange(base, base + eps, dtype=np.int32))
+        assert (recv >= 4).all() and (recv <= 5).all(), \
+            f"shard {s} (old dev {old_dev} -> {moved_dev}): {recv}"
+        total += int(recv.sum())
+    assert total == n_shards * eps * 5 - 2 * eps
+    assert sys.total_dropped == 0
+
+
+def test_rebalance_explicit_target_device():
+    spec = DeviceEntity("tgt", dev_counter, n_shards=8, entities_per_shard=4,
+                        n_devices=8, spare_blocks=8, payload_width=P)
+    region = DeviceShardRegion(spec)
+    r = region.entity_ref("x")
+    r.tell([1.0, 0, 0, 0])
+    region.run(1)
+    region.block_until_ready()
+    assert r.read_state("n") == 1
+    target = (region.device_of_shard(r.shard) + 1) % 8
+    region.rebalance(r.shard, to_device=target)
+    assert region.device_of_shard(r.shard) == target
+    # same entity handle keeps working post-move (row resolved via table)
+    r.tell([1.0, 0, 0, 0])
+    region.run(1)
+    region.block_until_ready()
+    assert r.read_state("n") == 2
+
+
+def test_init_device_via_typed_api():
+    from akka_tpu import ActorSystem
+    from akka_tpu.sharding.typed import ClusterShardingTyped
+    system = ActorSystem("devshard", {"akka": {"stdout-loglevel": "OFF"}})
+    try:
+        sharding = ClusterShardingTyped.get(system)
+        spec = DeviceEntity("api-counters", dev_counter, n_shards=4,
+                            entities_per_shard=8, payload_width=P)
+        region = sharding.init_device(spec)
+        assert sharding.device_region("api-counters") is region
+        ref = region.entity_ref("e-1")
+        ref.tell([1.0, 0, 0, 0])
+        region.run(1)
+        region.block_until_ready()
+        assert ref.read_state("n") == 1
+    finally:
+        system.terminate()
+        system.await_termination(10)
