@@ -139,6 +139,14 @@ class ActorSystem:
         from ..event.flight_recorder import from_config as _fr_from_config
         self.flight_recorder = _fr_from_config(cfg)
 
+        # multi-host data plane: opt-in jax.distributed bootstrap (DCN) so
+        # device meshes span every process in the cluster (SURVEY.md §2.3
+        # TPU-native equivalent; akka.jax-distributed.* config)
+        if cfg.get_bool("akka.jax-distributed.enabled", False):
+            from ..parallel.mesh import \
+                maybe_initialize_distributed_from_config
+            maybe_initialize_distributed_from_config(cfg)
+
         sched_impl = cfg.get_string("akka.scheduler.implementation", "default")
         self.scheduler = None
         if sched_impl == "native":

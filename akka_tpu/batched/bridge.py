@@ -89,6 +89,16 @@ def reply_dst(payload) -> Any:
     return payload[-1].astype(jnp.int32)
 
 
+def _slice_init(value, idx_or_mask, n_rows: int):
+    """Select the per-row slice of an init value: arrays whose leading dim
+    matches the spawn's row count are per-row (spawn_block broadcast
+    semantics); anything else is a scalar/broadcast value."""
+    v = np.asarray(value)
+    if v.ndim >= 1 and v.shape[0] == n_rows:
+        return v[idx_or_mask]
+    return value
+
+
 # ----------------------------------------------------------------- the handle
 class _SpawnRecord:
     __slots__ = ("behavior", "n", "init_state", "rows")
@@ -222,10 +232,24 @@ class BatchedRuntimeHandle:
 
     def stop_rows(self, rows) -> None:
         self._ensure_runtime()
+        arr = np.atleast_1d(np.asarray(rows, np.int32))
         with self._step_lock:
             # re-resolve under the lock: a concurrent _rebuild (which holds
             # this lock) may have swapped the runtime since the build check
-            self._runtime.stop_block(np.atleast_1d(np.asarray(rows, np.int32)))
+            self._runtime.stop_block(arr)
+            # prune init records: a recycled row's NEW occupant must never
+            # inherit the old spawn's init values on restart
+            pruned = []
+            for rec_rows, init in self._spawn_inits:
+                mask = ~np.isin(rec_rows, arr)
+                if mask.all():
+                    pruned.append((rec_rows, init))
+                elif mask.any():
+                    # per-row array inits stay aligned with their rows
+                    pruned.append((rec_rows[mask],
+                                   {c: _slice_init(v, mask, rec_rows.size)
+                                    for c, v in init.items()}))
+            self._spawn_inits = pruned
 
     def read_state(self, col: str, rows=None) -> np.ndarray:
         """Read state columns without racing an in-flight step's buffer
@@ -559,14 +583,17 @@ class BatchedRuntimeHandle:
             if self.failure_policy == "restart":
                 rt.restart_rows(failed)
                 # restore spawn-time init values for the restarted rows
-                # (an Akka restart re-instantiates from Props)
+                # (an Akka restart re-instantiates from Props); per-row
+                # array inits are sliced to the failed positions so values
+                # stay aligned with their rows
                 for rows, init in self._spawn_inits:
-                    hit = failed[np.isin(failed, rows)]
-                    if hit.size:
+                    pos = np.nonzero(np.isin(rows, failed))[0]
+                    if pos.size:
+                        hit = jnp.asarray(rows[pos])
                         for col, value in init.items():
-                            rt.state[col] = rt.state[col].at[
-                                jnp.asarray(hit)].set(
-                                jnp.asarray(value, rt.state[col].dtype))
+                            v = _slice_init(value, pos, rows.size)
+                            rt.state[col] = rt.state[col].at[hit].set(
+                                jnp.asarray(v, rt.state[col].dtype))
                 self._reported_failed.clear()
             elif self.failure_policy == "stop":
                 rt.stop_block(failed)

@@ -219,12 +219,21 @@ class BatchedSystem:
             stale = jnp.isin(self.inbox_dst, ridx)
             self.inbox_valid = jnp.where(stale, False, self.inbox_valid)
             if self._stager is not None:
+                # drain + filter + re-stage. Caveat: a producer staging
+                # concurrently can interleave ahead of re-staged (older)
+                # messages — spawn-into-recycled-rows is a slow path and
+                # same-sender interleaving requires that sender to race its
+                # own spawn. Short counts are real drops and are reported.
                 d, r = self._stager.drain()
                 if d.shape[0]:
                     keep = ~np.isin(d, rec_arr)
                     if keep.any():
-                        self._stager.stage(np.ascontiguousarray(d[keep]),
-                                           np.ascontiguousarray(r[keep]))
+                        staged = self._stager.stage(
+                            np.ascontiguousarray(d[keep]),
+                            np.ascontiguousarray(r[keep]))
+                        n_lost = int(keep.sum()) - staged
+                        if n_lost > 0 and self.on_dropped is not None:
+                            self.on_dropped(n_lost)
             with self._lock:
                 rec_set = set(int(i) for i in rec_arr)
                 self._host_staged = [e for e in self._host_staged

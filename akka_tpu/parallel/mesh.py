@@ -50,3 +50,42 @@ def replicated_spec(mesh: Mesh) -> NamedSharding:
 
 def host_device_count() -> int:
     return jax.device_count()
+
+
+_distributed_initialized = False
+_distributed_lock = __import__("threading").Lock()
+
+
+def initialize_distributed(coordinator_address: str,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> bool:
+    """Bring up the MULTI-HOST jax runtime (DCN): after this,
+    jax.devices() spans every process's chips and a Mesh built from them
+    crosses hosts — the data plane's equivalent of Artery binding its
+    transport (ArteryTransport.scala:328-470). Idempotent; returns whether
+    this call performed the initialization."""
+    global _distributed_initialized
+    with _distributed_lock:
+        if _distributed_initialized:
+            return False
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+        _distributed_initialized = True
+        return True
+
+
+def maybe_initialize_distributed_from_config(config) -> bool:
+    """ActorSystem bootstrap hook: `akka.jax-distributed.enabled = true`
+    plus coordinator-address/num-processes/process-id (process-id defaults
+    from the standard env vars jax honors). The control plane (membership
+    gossip over TCP) and the data plane (collectives over DCN) then share
+    one process topology."""
+    if config is None or not config.get_bool("akka.jax-distributed.enabled",
+                                             False):
+        return False
+    addr = config.get_string("akka.jax-distributed.coordinator-address", "")
+    n = config.get_int("akka.jax-distributed.num-processes", 0) or None
+    pid = config.get_int("akka.jax-distributed.process-id", -1)
+    return initialize_distributed(addr or None, n,
+                                  pid if pid >= 0 else None)

@@ -183,12 +183,17 @@ class DeviceShardRegion:
             n_new = idx + 1 - self._spawned[shard]
             start_idx = int(self._spawned[shard])
             self._spawned[shard] = idx + 1
-        base = int(self._shard_block[shard]) * self.eps
-        rows = np.arange(base + start_idx, base + start_idx + n_new,
-                         dtype=np.int32)
-        sys = self.system
-        sys.behavior_id = sys.behavior_id.at[jnp.asarray(rows)].set(0)
-        sys.alive = sys.alive.at[jnp.asarray(rows)].set(True)
+            # the array read-modify-writes stay under the lock: two threads
+            # activating entities concurrently must not overwrite each
+            # other's alive updates (each .at produces a NEW array from its
+            # thread's snapshot). Spawning still must not race run() — the
+            # step donates these buffers; activate entities between steps.
+            base = int(self._shard_block[shard]) * self.eps
+            rows = np.arange(base + start_idx, base + start_idx + n_new,
+                             dtype=np.int32)
+            sys = self.system
+            sys.behavior_id = sys.behavior_id.at[jnp.asarray(rows)].set(0)
+            sys.alive = sys.alive.at[jnp.asarray(rows)].set(True)
 
     def allocate_all(self) -> None:
         """Bulk-activate every entity slot (bench path: 256x4k rows live
