@@ -237,8 +237,10 @@ class BatchedRuntimeHandle:
             # re-resolve under the lock: a concurrent _rebuild (which holds
             # this lock) may have swapped the runtime since the build check
             self._runtime.stop_block(arr)
-            # prune init records: a recycled row's NEW occupant must never
-            # inherit the old spawn's init values on restart
+        with self._lock:
+            # prune init records UNDER THE SAME LOCK spawn() appends with —
+            # a recycled row's NEW occupant must never inherit the old
+            # spawn's init values on restart
             pruned = []
             for rec_rows, init in self._spawn_inits:
                 mask = ~np.isin(rec_rows, arr)

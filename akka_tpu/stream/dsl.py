@@ -25,6 +25,7 @@ from .interpreter import ActorGraphInterpreter, Connection, GraphInterpreter
 from .stage import (FlowShape, GraphStage, GraphStageLogic, Inlet, Outlet,
                     SinkShape, SourceShape, make_in_handler, make_out_handler)
 from . import ops as _ops
+from . import ops2 as _ops2
 
 
 class Keep:
@@ -340,6 +341,11 @@ class Flow:
 
     # -- operator library (reference: scaladsl/Flow.scala ~200 defs;
     #    the stages live in akka_tpu/stream/ops.py) --------------------------
+    def via_stage(self, stage_factory) -> "Flow":
+        """Append any custom 1-in/1-out GraphStage (the GraphStage SPI of
+        stream/stage/GraphStage.scala for user-defined operators)."""
+        return self._append(stage_factory)
+
     def map(self, fn) -> "Flow":
         return self._append(lambda: _ops.Map(fn))
 
@@ -474,6 +480,85 @@ class Flow:
             return logic.shape.out, m1
         return Flow(build)
 
+    # -- sub-streams (impl/fusing/StreamOfStreams.scala) ---------------------
+    def group_by(self, max_substreams: int, key_fn,
+                 sub_buffer: int = 1024) -> "Flow":
+        """Demultiplex into (key, Source) pairs, one per distinct key."""
+        from .substreams import GroupBy
+        return self._append(lambda: GroupBy(max_substreams, key_fn,
+                                            sub_buffer))
+
+    def split_when(self, predicate) -> "Flow":
+        from .substreams import SplitWhen
+        return self._append(lambda: SplitWhen(predicate, after=False))
+
+    def split_after(self, predicate) -> "Flow":
+        from .substreams import SplitWhen
+        return self._append(lambda: SplitWhen(predicate, after=True))
+
+    def flat_map_merge(self, breadth: int, fn) -> "Flow":
+        from .substreams import FlatMapMerge
+        return self._append(lambda: FlatMapMerge(breadth, fn))
+
+    def prefix_and_tail(self, n: int) -> "Flow":
+        from .substreams import PrefixAndTail
+        return self._append(lambda: PrefixAndTail(n))
+
+    def merge_substreams(self, breadth: int = 16) -> "Flow":
+        """Flatten a stream of Sources (or (key, Source) pairs from
+        group_by) by merging up to `breadth` concurrently."""
+        def pick(x):
+            return x[1] if isinstance(x, tuple) and len(x) == 2 else x
+        return self.flat_map_merge(breadth, pick)
+
+    def concat_substreams(self) -> "Flow":
+        def pick(x):
+            return x[1] if isinstance(x, tuple) and len(x) == 2 else x
+        return self.flat_map_concat(pick)
+
+    # -- timed windows / limits / timeouts (impl/Timers.scala, Ops.scala) ----
+    def take_within(self, seconds: float) -> "Flow":
+        return self._append(lambda: _ops2.TakeWithin(seconds))
+
+    def drop_within(self, seconds: float) -> "Flow":
+        return self._append(lambda: _ops2.DropWithin(seconds))
+
+    def grouped_within(self, n: int, seconds: float) -> "Flow":
+        return self._append(lambda: _ops2.GroupedWithin(n, seconds))
+
+    def limit(self, max_elements: int) -> "Flow":
+        return self._append(lambda: _ops2.Limit(max_elements))
+
+    def limit_weighted(self, max_cost: int, cost_fn) -> "Flow":
+        return self._append(lambda: _ops2.Limit(max_cost, cost_fn))
+
+    def initial_timeout(self, seconds: float) -> "Flow":
+        return self._append(lambda: _ops2.InitialTimeout(seconds))
+
+    def completion_timeout(self, seconds: float) -> "Flow":
+        return self._append(lambda: _ops2.CompletionTimeout(seconds))
+
+    def idle_timeout(self, seconds: float) -> "Flow":
+        return self._append(lambda: _ops2.IdleTimeout(seconds))
+
+    def keep_alive(self, seconds: float, inject_fn) -> "Flow":
+        return self._append(lambda: _ops2.KeepAlive(seconds, inject_fn))
+
+    # -- errors / termination ------------------------------------------------
+    def map_error(self, fn) -> "Flow":
+        return self._append(lambda: _ops2.MapError(fn))
+
+    def deduplicate(self, key_fn=None) -> "Flow":
+        return self._append(lambda: _ops2.Deduplicate(key_fn))
+
+    def recover_with_retries(self, attempts: int, fn) -> "Flow":
+        return self._append(lambda: _ops2.RecoverWithRetries(attempts, fn))
+
+    def watch_termination(self) -> "Flow":
+        """Mat value becomes a Future completing with the stream's end."""
+        return self._append(lambda: _ops2.WatchTermination(),
+                            combine=Keep.right)
+
 
 class Sink:
     """build(b, upstream_outlet) -> mat."""
@@ -553,3 +638,38 @@ class RunnableGraph:
         if not isinstance(mat, Materializer):
             mat = Materializer(getattr(mat, "classic", mat))
         return mat.materialize(self._build)
+
+
+# -- Source gets the whole linear operator library ----------------------------
+# (scaladsl/Source.scala mirrors Flow's operators; delegating through
+# `self.via(Flow().<op>(...))` keeps one implementation per stage)
+_SOURCE_MIRRORED_OPS = [
+    "map", "map_concat", "stateful_map_concat", "filter", "filter_not",
+    "collect", "take", "take_while", "drop", "drop_while", "scan", "fold",
+    "reduce", "grouped", "sliding", "intersperse", "zip_with_index",
+    "buffer", "conflate", "conflate_with_seed", "batch", "expand",
+    "map_async", "map_async_unordered", "throttle", "delay", "recover",
+    "log", "flat_map_concat", "via_stage",
+    "group_by", "split_when", "split_after", "flat_map_merge",
+    "prefix_and_tail", "merge_substreams", "concat_substreams",
+    "take_within", "drop_within", "grouped_within", "limit",
+    "limit_weighted", "initial_timeout", "completion_timeout",
+    "idle_timeout", "keep_alive", "map_error", "deduplicate",
+    "recover_with_retries", "watch_termination",
+]
+
+
+def _mirror_op(name: str):
+    def method(self, *args, **kwargs):
+        flow = getattr(Flow(), name)(*args, **kwargs)
+        combine = Keep.right if name == "watch_termination" else Keep.left
+        return self.via(flow, combine)
+    method.__name__ = name
+    method.__qualname__ = f"Source.{name}"
+    return method
+
+
+for _name in _SOURCE_MIRRORED_OPS:
+    if not hasattr(Source, _name):
+        setattr(Source, _name, _mirror_op(_name))
+del _name

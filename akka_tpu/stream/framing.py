@@ -1,0 +1,195 @@
+"""Framing: delimiter- and length-field-based byte-stream framing.
+
+Reference parity: akka-stream scaladsl/Framing.scala — `delimiter`
+(split on a byte marker, enforce max frame length), `lengthField`
+(binary length-prefixed frames), and `simpleFramingProtocol` (the
+encoder/decoder pair for symmetric length-prefixed wire protocols, as
+used over TCP). Stages operate on bytes CHUNKS with arbitrary
+boundaries — reassembly is the whole point.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List
+
+from .ops import _LinearStage, make_in_handler, make_out_handler
+
+
+class FramingException(RuntimeError):
+    pass
+
+
+class DelimiterFraming(_LinearStage):
+    def __init__(self, delimiter: bytes, maximum_frame_length: int = 1 << 20,
+                 allow_truncation: bool = False):
+        super().__init__("DelimiterFraming")
+        if not delimiter:
+            raise ValueError("empty delimiter")
+        self.delimiter = bytes(delimiter)
+        self.max_len = maximum_frame_length
+        self.allow_truncation = allow_truncation
+
+    def create_logic(self):
+        logic, in_, out = self._logic(), self.in_, self.out
+        stage = self
+        buf = bytearray()
+        pending: List[bytes] = []
+
+        def split() -> None:
+            while True:
+                i = buf.find(stage.delimiter)
+                if i < 0:
+                    if len(buf) > stage.max_len:
+                        raise FramingException(
+                            f"frame exceeds {stage.max_len} bytes without "
+                            f"delimiter")
+                    return
+                if i > stage.max_len:
+                    raise FramingException(
+                        f"frame of {i} bytes exceeds {stage.max_len}")
+                pending.append(bytes(buf[:i]))
+                del buf[:i + len(stage.delimiter)]
+
+        def on_push():
+            buf.extend(logic.grab(in_))
+            try:
+                split()
+            except FramingException as e:
+                logic.fail_stage(e)
+                return
+            if pending:
+                logic.push(out, pending.pop(0))
+            else:
+                logic.pull(in_)
+
+        def on_finish():
+            if buf:
+                if not stage.allow_truncation:
+                    logic.fail_stage(FramingException(
+                        "stream finished with truncated frame"))
+                    return
+                pending.append(bytes(buf))
+                buf.clear()
+            if pending:
+                logic.emit_multiple(out, list(pending))
+                pending.clear()
+            logic.complete_stage()
+
+        def on_pull():
+            if pending:
+                logic.push(out, pending.pop(0))
+            else:
+                logic.pull(in_)
+
+        logic.set_handler(in_, make_in_handler(on_push, on_finish))
+        logic.set_handler(out, make_out_handler(on_pull))
+        return logic
+
+
+class LengthFieldFraming(_LinearStage):
+    """Frames = [length field][payload]; emits payload-only frames unless
+    include_header. Big-endian unsigned length of field_length bytes."""
+
+    def __init__(self, field_length: int, maximum_frame_length: int = 1 << 20,
+                 field_offset: int = 0, include_header: bool = False):
+        super().__init__("LengthFieldFraming")
+        if field_length not in (1, 2, 4, 8):
+            raise ValueError("field_length must be 1, 2, 4 or 8")
+        self.field_length = field_length
+        self.field_offset = field_offset
+        self.max_len = maximum_frame_length
+        self.include_header = include_header
+
+    def _decode_len(self, data: bytes) -> int:
+        return int.from_bytes(data, "big")
+
+    def create_logic(self):
+        logic, in_, out = self._logic(), self.in_, self.out
+        stage = self
+        buf = bytearray()
+        pending: List[bytes] = []
+        head = stage.field_offset + stage.field_length
+
+        def split() -> None:
+            while len(buf) >= head:
+                n = stage._decode_len(
+                    bytes(buf[stage.field_offset:head]))
+                if n > stage.max_len:
+                    raise FramingException(
+                        f"frame of {n} bytes exceeds {stage.max_len}")
+                total = head + n
+                if len(buf) < total:
+                    return
+                frame = bytes(buf[:total]) if stage.include_header \
+                    else bytes(buf[head:total])
+                pending.append(frame)
+                del buf[:total]
+
+        def on_push():
+            buf.extend(logic.grab(in_))
+            try:
+                split()
+            except FramingException as e:
+                logic.fail_stage(e)
+                return
+            if pending:
+                logic.push(out, pending.pop(0))
+            else:
+                logic.pull(in_)
+
+        def on_finish():
+            if buf:
+                logic.fail_stage(FramingException(
+                    "stream finished with truncated frame"))
+                return
+            if pending:
+                logic.emit_multiple(out, list(pending))
+                pending.clear()
+            logic.complete_stage()
+
+        def on_pull():
+            if pending:
+                logic.push(out, pending.pop(0))
+            else:
+                logic.pull(in_)
+
+        logic.set_handler(in_, make_in_handler(on_push, on_finish))
+        logic.set_handler(out, make_out_handler(on_pull))
+        return logic
+
+
+class Framing:
+    """Factory namespace (scaladsl/Framing.scala)."""
+
+    @staticmethod
+    def delimiter(delimiter: bytes, maximum_frame_length: int = 1 << 20,
+                  allow_truncation: bool = False):
+        from .dsl import Flow
+        return Flow().via_stage(lambda: DelimiterFraming(
+            delimiter, maximum_frame_length, allow_truncation))
+
+    @staticmethod
+    def length_field(field_length: int, maximum_frame_length: int = 1 << 20,
+                     field_offset: int = 0, include_header: bool = False):
+        from .dsl import Flow
+        return Flow().via_stage(lambda: LengthFieldFraming(
+            field_length, maximum_frame_length, field_offset, include_header))
+
+    @staticmethod
+    def simple_framing_protocol_encoder(maximum_frame_length: int = 1 << 20):
+        """bytes frame -> [u32 length][frame] (the symmetric encoder of
+        simpleFramingProtocol)."""
+        from .dsl import Flow
+
+        def encode(frame: bytes) -> bytes:
+            if len(frame) > maximum_frame_length:
+                raise FramingException(
+                    f"frame of {len(frame)} exceeds {maximum_frame_length}")
+            return struct.pack(">I", len(frame)) + frame
+
+        return Flow().map(encode)
+
+    @staticmethod
+    def simple_framing_protocol_decoder(maximum_frame_length: int = 1 << 20):
+        return Framing.length_field(4, maximum_frame_length)

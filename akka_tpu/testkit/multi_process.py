@@ -126,34 +126,56 @@ def spawn_nodes(worker_source: str, n_nodes: int,
     Raises on nonzero exit or timeout (with stderr attached)."""
     conductor = Conductor(n_nodes)
     procs: List[subprocess.Popen] = []
+    drains: List[threading.Thread] = []
+    outs: List[Dict[str, bytes]] = []
     try:
         for i in range(n_nodes):
             env = sanitized_env(extra_env)
             env["AKKA_TPU_NODE_INDEX"] = str(i)
             env["AKKA_TPU_NODE_COUNT"] = str(n_nodes)
             env["AKKA_TPU_CONDUCTOR_PORT"] = str(conductor.port)
-            procs.append(subprocess.Popen(
+            p = subprocess.Popen(
                 [sys.executable, "-u", "-c", worker_source],
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env))
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env)
+            procs.append(p)
+            # drain BOTH pipes concurrently for EVERY node: a verbose
+            # worker blocked on a full pipe would otherwise never reach
+            # its barrier and stall the whole group until timeout
+            cap: Dict[str, bytes] = {"out": b"", "err": b""}
+            outs.append(cap)
+
+            def _drain(stream, key, cap=cap):
+                cap[key] = stream.read()
+
+            for stream, key in ((p.stdout, "out"), (p.stderr, "err")):
+                t = threading.Thread(target=_drain, args=(stream, key),
+                                     daemon=True)
+                t.start()
+                drains.append(t)
         deadline = time.monotonic() + timeout
         stderrs: List[str] = []
         for i, p in enumerate(procs):
             left = max(1.0, deadline - time.monotonic())
             try:
-                out, err = p.communicate(timeout=left)
+                p.wait(timeout=left)
             except subprocess.TimeoutExpired:
                 for q in procs:
                     q.kill()
-                out, err = p.communicate()
+                for t in drains:
+                    t.join(2.0)
                 raise AssertionError(
                     f"node {i} timed out after {timeout}s\n"
-                    f"--- node {i} stderr ---\n{err.decode()[-4000:]}")
-            stderrs.append(err.decode())
+                    f"--- node {i} stderr ---\n"
+                    f"{outs[i]['err'].decode()[-4000:]}")
+        for t in drains:
+            t.join(5.0)
+        for i, p in enumerate(procs):
+            stderrs.append(outs[i]["err"].decode())
             if p.returncode != 0:
                 raise AssertionError(
                     f"node {i} exited {p.returncode}\n"
-                    f"--- node {i} stderr ---\n{err.decode()[-4000:]}\n"
-                    f"--- node {i} stdout ---\n{out.decode()[-2000:]}")
+                    f"--- node {i} stderr ---\n{outs[i]['err'].decode()[-4000:]}\n"
+                    f"--- node {i} stdout ---\n{outs[i]['out'].decode()[-2000:]}")
         return dict(conductor.results), stderrs
     finally:
         for p in procs:
