@@ -22,6 +22,7 @@ from .config import Config, reference_config  # noqa: F401
 from .actor.system import ActorSystem, ExtensionId, CoordinatedShutdown  # noqa: F401
 from .actor.actor import Actor, Stash, FunctionActor  # noqa: F401
 from .actor.props import Props  # noqa: F401
+from .actor.deploy import Deploy, LocalScope, RemoteScope  # noqa: F401
 from .actor.ref import ActorRef, Nobody  # noqa: F401
 from .actor.path import ActorPath, Address  # noqa: F401
 from .actor.messages import (  # noqa: F401

@@ -54,6 +54,10 @@ class ActorCell:
         self._behavior_stack: list[Callable[[Any], Any]] = []
         self._children: Dict[str, InternalActorRef] = {}
         self._child_stats: Dict[str, ChildRestartStats] = {}
+        # remote-deployed children: named like children (uniqueness, lookup,
+        # stop-on-terminate) but NOT awaited during termination — their cell
+        # lives on another node (remote/deploy.py daemon owns supervision)
+        self._remote_children: Dict[str, InternalActorRef] = {}
         self._children_lock = threading.RLock()
         self.current_message: Optional[Envelope] = None
         self.sender: Optional[ActorRef] = None
@@ -101,7 +105,8 @@ class ActorCell:
         return list(self._children.values())
 
     def child(self, name: str) -> Optional[InternalActorRef]:
-        return self._children.get(name)
+        c = self._children.get(name)
+        return c if c is not None else self._remote_children.get(name)
 
     def get_single_child(self, name: str) -> Optional[InternalActorRef]:
         if "#" in name:
@@ -122,13 +127,20 @@ class ActorCell:
                 name = f"$" + _base64(next(self._temp_counter))
             else:
                 validate_path_element(name)
-            if name in self._children:
+            if name in self._children or name in self._remote_children:
                 raise InvalidActorNameException(
                     f"actor name [{name}] is not unique in {self.self_ref.path}")
             child = self.system.provider.actor_of(
                 self.system, props, self.self_ref, self.self_ref.path.child(name).with_uid(new_uid()))
-            self._children[name] = child
-            self._child_stats[name] = ChildRestartStats(child)
+            if getattr(child, "is_local", True):
+                self._children[name] = child
+                self._child_stats[name] = ChildRestartStats(child)
+            else:
+                # remote-deployed — it lives under the remote daemon, which
+                # watches this parent and stops the child when we die
+                # (remote/deploy.py; no local sysmsg channel exists for it),
+                # but it keeps its name here for uniqueness + child() lookup
+                self._remote_children[name] = child
         child.start()
         return child
 
@@ -424,6 +436,11 @@ class ActorCell:
         self.set_receive_timeout(None)
         if not self._terminating:
             self._terminating = True
+            # remote-deployed children: fire-and-forget stop (their daemon
+            # also watches us, so this is belt-and-braces, not awaited)
+            for rc in list(self._remote_children.values()):
+                rc.stop()
+            self._remote_children.clear()
             children = self.children
             if children:
                 for child in children:

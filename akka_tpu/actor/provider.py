@@ -44,9 +44,11 @@ class Guardian(Actor):
 
 class LocalActorRefProvider:
     def __init__(self, system_name: str, settings, event_stream):
+        from .deploy import Deployer
         self.system_name = system_name
         self.settings = settings
         self.event_stream = event_stream
+        self.deployer = Deployer(settings)
         self.root_path = ActorPath(Address("akka", system_name))
         self.dead_letters = DeadLetterActorRef(self.root_path / "deadLetters", event_stream)
         self.ignore_ref = MinimalActorRef(self.root_path / "ignore")
@@ -80,9 +82,35 @@ class LocalActorRefProvider:
     def guardian(self) -> LocalActorRef:
         return self.user_guardian
 
+    # -- deployment resolution (reference: Deployer.lookup consulted from
+    # actorOf; the config entry wins over the programmatic Props.deploy) -----
+    def effective_props(self, props: Props, path: ActorPath):
+        """Merge `akka.actor.deployment` config with props.deploy; returns
+        (props, deploy). Only /user-subtree actors are deployable."""
+        from .deploy import NO_SCOPE, Deploy
+        from dataclasses import replace as _replace
+        elements = list(path.elements)
+        cfg_deploy = (self.deployer.lookup(elements[1:])
+                      if len(elements) > 1 and elements[0] == "user" else None)
+        deploy = props.deploy
+        if cfg_deploy is not None:
+            deploy = cfg_deploy.with_fallback(deploy) if deploy is not None \
+                else cfg_deploy
+        if deploy is None:
+            return props, None
+        if props.router_config is None and deploy.router_config is not None:
+            props = _replace(props, router_config=deploy.router_config)
+        if props.dispatcher is None and deploy.dispatcher is not None:
+            props = props.with_dispatcher(deploy.dispatcher)
+        if props.mailbox is None and deploy.mailbox is not None:
+            props = props.with_mailbox(deploy.mailbox)
+        return props, deploy
+
     # -- actorOf (reference: ActorRefProvider.actorOf :116) ------------------
     def actor_of(self, system, props: Props, supervisor: InternalActorRef,
-                 path: ActorPath) -> InternalActorRef:
+                 path: ActorPath, _resolved: bool = False) -> InternalActorRef:
+        if not _resolved:
+            props, _deploy = self.effective_props(props, path)
         if props.device is not None:
             # device-resident actor: rows in the tpu-batched runtime behind
             # an ordinary ref — no cell, no host mailbox (the Dispatchers

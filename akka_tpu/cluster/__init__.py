@@ -13,3 +13,5 @@ from .events import (ClusterDomainEvent, CurrentClusterState,  # noqa: F401
                      UnreachableMember)
 from .sbr import (DownAll, DowningStrategy, KeepMajority,  # noqa: F401
                   KeepOldest, SplitBrainResolver, StaticQuorum)
+from .routing import (ClusterRouterGroup, ClusterRouterGroupSettings,  # noqa: F401
+                      ClusterRouterPool, ClusterRouterPoolSettings)

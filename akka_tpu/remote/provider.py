@@ -225,6 +225,13 @@ class RemoteActorRefProvider(LocalActorRefProvider):
         resend_interval = cfg.get_duration("akka.remote.system-message-resend-interval", "1s")
         self._resend_task = system.scheduler.schedule_with_fixed_delay(
             resend_interval, resend_interval, self._resend_pending)
+        # /remote daemon: instantiates DaemonMsgCreate recipes from peers
+        # (reference: RemoteSystemDaemon under the root guardian)
+        from .deploy import RemoteSystemDaemon
+        self.remote_daemon = self.root_guardian.cell.actor_of(
+            Props.create(RemoteSystemDaemon, self).with_dispatcher(
+                system.dispatchers.INTERNAL_DISPATCHER_ID),
+            "remote")
         system.register_on_termination(self.shutdown_transport)
 
     def shutdown_transport(self) -> None:
@@ -372,6 +379,25 @@ class RemoteActorRefProvider(LocalActorRefProvider):
         recipient = self.resolve_actor_ref(env.recipient)
         sender = (self.resolve_actor_ref(env.sender) if env.sender
                   else self.dead_letters)
+        if recipient is self.dead_letters:
+            # a message (user OR system: Watch must not be lost either) that
+            # raced a remote deployment: hand it to the daemon, which buffers
+            # until DaemonMsgCreate lands (remote/deploy.py)
+            try:
+                elements = list(parse_actor_path(env.recipient).elements)
+            except ValueError:
+                elements = []
+            if len(elements) == 2 and elements[0] == "remote":
+                from .deploy import _DeliverToChild
+                self.remote_daemon.tell(
+                    _DeliverToChild(elements[1], message, sender,
+                                    system=env.is_system))
+                if ack_after_delivery is not None:
+                    addr, assoc, seq = ack_after_delivery
+                    with assoc.lock:
+                        assoc.last_delivered_seq = max(assoc.last_delivered_seq, seq)
+                    self._send_ack(addr, assoc)
+                return
         if isinstance(message, _RemoteTerminate):
             if isinstance(recipient, InternalActorRef):
                 recipient.stop()
@@ -393,6 +419,20 @@ class RemoteActorRefProvider(LocalActorRefProvider):
                            from_address=str(self.local_address), from_uid=self.uid,
                            lane="control")
         self.transport.send(addr.host, addr.port, env)
+
+    # -- remote deployment (reference: RemoteActorRefProvider.actorOf :152
+    # — a RemoteScope deploy creates the actor on the remote node) -----------
+    def actor_of(self, system, props: Props, supervisor: InternalActorRef,
+                 path: ActorPath) -> InternalActorRef:
+        from ..actor.deploy import RemoteScope
+        eff_props, deploy = self.effective_props(props, path)
+        scope = getattr(deploy, "scope", None)
+        if (isinstance(scope, RemoteScope) and self.local_address is not None
+                and Address.parse(scope.address) != self.local_address):
+            from .deploy import remote_deploy
+            return remote_deploy(self, eff_props, path, deploy)
+        return super().actor_of(system, eff_props, supervisor, path,
+                                _resolved=True)
 
     # -- resolution ----------------------------------------------------------
     def resolve_actor_ref(self, path: Any) -> ActorRef:

@@ -103,10 +103,14 @@ class RouterActor(Actor):
 class RoutedActorCell(ActorCell):
     def __init__(self, system, self_ref, props: Props, dispatcher_id, parent):
         # the cell's own actor is the RouterActor; routees use the user props
+        from dataclasses import replace
         router_config = props.router_config
-        self.routee_props = Props(factory=props.factory, cls=props.cls,
-                                  dispatcher=props.dispatcher, mailbox=props.mailbox)
-        router_actor_props = Props.create(RouterActor, router_config)
+        self.routee_props = replace(props, router_config=None, deploy=None,
+                                    device=None)
+        # cluster-aware configs supply their own router actor (cluster/
+        # routing.py; reference: ClusterRouterActor in cluster/routing/)
+        actor_cls = getattr(router_config, "router_actor_class", RouterActor)
+        router_actor_props = Props.create(actor_cls, router_config)
         super().__init__(system, self_ref, router_actor_props, dispatcher_id, parent)
         self.router: Router = router_config.create_router(system)
         self.router_config = router_config

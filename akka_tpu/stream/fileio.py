@@ -102,7 +102,15 @@ class FileSink(_SinkStage):
             state["fh"].close()
             return IOResult(state["count"])
 
-        return _sink_logic(stage, write, fut, result_fn=result), fut
+        def cleanup() -> None:
+            # upstream failed / write raised: flush + close what we have so
+            # the fd never leaks and the tail bytes reach disk
+            if state["fh"] is not None:
+                state["fh"].close()
+                state["fh"] = None
+
+        return _sink_logic(stage, write, fut, result_fn=result,
+                           cleanup_fn=cleanup), fut
 
 
 class FileIO:

@@ -1747,9 +1747,17 @@ class _PullAllLogic(GraphStageLogic):
 
 def _sink_logic(stage: "_SinkStage", on_elem, fut: Future,
                 result_fn=lambda: None,
-                empty_error: Optional[Callable[[], BaseException]] = None):
+                empty_error: Optional[Callable[[], BaseException]] = None,
+                cleanup_fn=None):
     logic = _PullAllLogic(stage._shape, stage.in_)
     in_ = stage.in_
+
+    def _cleanup():
+        if cleanup_fn is not None:
+            try:
+                cleanup_fn()
+            except Exception:  # noqa: BLE001 — cleanup must not mask the error
+                pass
 
     def on_push():
         try:
@@ -1757,6 +1765,7 @@ def _sink_logic(stage: "_SinkStage", on_elem, fut: Future,
         except Exception as e:  # noqa: BLE001
             if not fut.done():
                 fut.set_exception(e)
+            _cleanup()
             logic.cancel_stage(e)
             return
         logic.pull(in_)
@@ -1773,6 +1782,7 @@ def _sink_logic(stage: "_SinkStage", on_elem, fut: Future,
     def on_failure(ex):
         if not fut.done():
             fut.set_exception(ex)
+        _cleanup()
         logic.fail_stage(ex)
     logic.set_handler(in_, make_in_handler(on_push, on_finish, on_failure))
     return logic

@@ -269,18 +269,25 @@ class FlatMapMerge(_LinearStage):
         stage = self
         buf: collections.deque = collections.deque()
         state = {"active": 0, "upstream_done": False}
+        switches: set = set()  # live sub-stream kill switches
 
         def maybe_finish():
             if state["upstream_done"] and state["active"] == 0 and not buf:
                 logic.complete_stage()
 
         def start_sub(src) -> None:
+            from .dsl import Keep, Sink
+            from .killswitch import KillSwitches
             state["active"] += 1
             on_elem = logic.get_async_callback(sub_elem)
             on_done = logic.get_async_callback(sub_done)
-            fut = src.run_foreach(lambda e: on_elem.invoke(e),
-                                  logic.materializer)
-            fut.add_done_callback(lambda f: on_done.invoke(f))
+            # a kill switch rides every sub-stream so stage teardown (fail,
+            # cancel, system stop) also stops still-running sub-interpreters
+            sw, fut = (src.via_mat(KillSwitches.single(), Keep.right)
+                       .to(Sink.foreach(lambda e: on_elem.invoke(e)), Keep.both)
+                       .run(logic.materializer))
+            switches.add(sw)
+            fut.add_done_callback(lambda f: on_done.invoke((sw, f)))
 
         def sub_elem(elem):
             if logic.is_available(out) and not buf:
@@ -288,7 +295,9 @@ class FlatMapMerge(_LinearStage):
             else:
                 buf.append(elem)
 
-        def sub_done(fut):
+        def sub_done(sw_fut):
+            sw, fut = sw_fut
+            switches.discard(sw)
             state["active"] -= 1
             exc = fut.exception() if fut is not None else None
             if exc is not None:
@@ -320,6 +329,16 @@ class FlatMapMerge(_LinearStage):
             else:
                 maybe_finish()
 
+        def post_stop():
+            # stage is going away for ANY reason — kill surviving sub-streams
+            for sw in list(switches):
+                try:
+                    sw.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
+            switches.clear()
+
+        logic.post_stop = post_stop
         logic.set_handler(in_, make_in_handler(on_push, on_finish))
         logic.set_handler(out, make_out_handler(on_pull))
         return logic

@@ -1,0 +1,321 @@
+"""Remote deployment + cluster-aware routers — modeled on the reference
+multi-jvm specs (akka-remote-tests RemoteDeploymentSpec semantics,
+akka-cluster/src/multi-jvm ClusterRoundRobinSpec; SURVEY.md §2.3, §2.4) run
+over the in-proc transport."""
+
+import time
+
+import pytest
+
+from akka_tpu import (Actor, ActorSystem, Deploy, Props, RemoteScope,
+                      Terminated, ask_sync)
+from akka_tpu.actor.deploy import Deployer, LocalScope, NO_SCOPE
+from akka_tpu.cluster import (Cluster, ClusterRouterGroup,
+                              ClusterRouterGroupSettings, ClusterRouterPool,
+                              ClusterRouterPoolSettings, MemberStatus)
+from akka_tpu.remote.deploy import (DaemonMsgCreate, mangle,
+                                    register_deployable)
+from akka_tpu.remote.transport import InProcTransport
+from akka_tpu.routing.router import (Broadcast, GetRoutees, RoundRobinGroup,
+                                     RoundRobinPool, Routees)
+from akka_tpu.testkit import await_condition
+
+
+def remote_system(name: str, extra=None) -> ActorSystem:
+    cfg = {"akka": {"actor": {"provider": "remote"},
+                    "stdout-loglevel": "OFF", "log-dead-letters": 0,
+                    "remote": {"transport": "inproc",
+                               "canonical": {"hostname": "local", "port": 0}}}}
+    if extra:
+        cfg["akka"]["actor"].update(extra)
+    return ActorSystem.create(name, cfg)
+
+
+def addr_of(system) -> str:
+    a = system.provider.local_address
+    return f"akka://{system.name}@{a.host}:{a.port}"
+
+
+@pytest.fixture()
+def two_systems():
+    InProcTransport.fault_injector.reset()
+    a = remote_system("depA")
+    b = remote_system("depB")
+    yield a, b
+    for s in (a, b):
+        s.terminate()
+    for s in (a, b):
+        assert s.await_termination(10.0)
+    InProcTransport.fault_injector.reset()
+
+
+@register_deployable
+class WhereAmI(Actor):
+    def __init__(self, tag="?"):
+        super().__init__()
+        self.tag = tag
+
+    def receive(self, message):
+        if message == "where":
+            self.sender.tell(
+                (self.tag, str(self.context.system.name),
+                 self.self_ref.path.to_serialization_format()),
+                self.self_ref)
+        elif message == "boom":
+            raise RuntimeError("boom")
+        else:
+            self.sender.tell(("echo", message), self.self_ref)
+
+
+# -- deployer config parsing --------------------------------------------------
+
+def test_deployer_lookup_literal_and_wildcard():
+    class S:
+        pass
+
+    from akka_tpu.config import Config
+    s = S()
+    s.config = Config({"akka": {"actor": {"deployment": {
+        "/service": {"remote": "akka://other@h:1"},
+        "/workers/*": {"dispatcher": "blocking-io-dispatcher"},
+        "/pool": {"router": "round-robin-pool", "nr-of-instances": 3},
+    }}}})
+    d = Deployer(s)
+    dep = d.lookup(["service"])
+    assert dep is not None and dep.scope.address == "akka://other@h:1"
+    assert d.lookup(["workers", "w7"]).dispatcher == "blocking-io-dispatcher"
+    assert d.lookup(["pool"]).router_config.nr_of_instances == 3
+    assert d.lookup(["nothing"]) is None
+    assert d.lookup(["service", "child"]) is None
+
+
+def test_deploy_with_fallback_merge():
+    a = Deploy(scope=RemoteScope("akka://x@h:1"))
+    b = Deploy(dispatcher="d1", scope=NO_SCOPE)
+    merged = a.with_fallback(b)
+    assert isinstance(merged.scope, RemoteScope)
+    assert merged.dispatcher == "d1"
+
+
+# -- programmatic remote deployment ------------------------------------------
+
+def test_remote_deploy_via_props(two_systems):
+    a, b = two_systems
+    ref = a.actor_of(
+        Props.create(WhereAmI, "t1").with_deploy(
+            Deploy(scope=RemoteScope(addr_of(b)))),
+        "worker")
+    tag, sysname, path = ask_sync(ref, "where", timeout=5.0, system=a)
+    assert tag == "t1"
+    assert sysname == "depB"          # the actor RUNS on b
+    assert "/remote/" in path          # under b's daemon
+    assert "akka://depB" in path
+    # ordinary messaging round-trips
+    assert ask_sync(ref, 42, timeout=5.0, system=a) == ("echo", 42)
+
+
+def test_remote_deploy_via_config(two_systems):
+    InProcTransport.fault_injector.reset()
+    b = remote_system("depB2")
+    a = None
+    try:
+        a = remote_system("depA2", extra={
+            "deployment": {"/cfg-worker": {"remote": addr_of(b)}}})
+        ref = a.actor_of(Props.create(WhereAmI, "cfg"), "cfg-worker")
+        tag, sysname, _ = ask_sync(ref, "where", timeout=5.0, system=a)
+        assert (tag, sysname) == ("cfg", "depB2")
+    finally:
+        for s in (a, b):
+            if s is not None:
+                s.terminate()
+                s.await_termination(10.0)
+
+
+def test_remote_deployed_actor_watchable_and_stoppable(two_systems):
+    a, b = two_systems
+    ref = a.actor_of(
+        Props.create(WhereAmI).with_deploy(Deploy(scope=RemoteScope(addr_of(b)))),
+        "mortal")
+    assert ask_sync(ref, "where", timeout=5.0, system=a)[1] == "depB"
+
+    seen = []
+
+    class Watcher(Actor):
+        def pre_start(self):
+            self.context.watch(ref)
+
+        def receive(self, message):
+            if isinstance(message, Terminated):
+                seen.append(message.actor)
+
+    a.actor_of(Props.create(Watcher), "watcher")
+    time.sleep(0.2)
+    ref.stop()
+    await_condition(lambda: len(seen) == 1, max_time=5.0,
+                    message="no Terminated for remote-deployed actor")
+
+
+def test_remote_deploy_restarts_on_failure(two_systems):
+    a, b = two_systems
+    ref = a.actor_of(
+        Props.create(WhereAmI, "sup").with_deploy(
+            Deploy(scope=RemoteScope(addr_of(b)))),
+        "crashy")
+    assert ask_sync(ref, "where", timeout=5.0, system=a)[0] == "sup"
+    ref.tell("boom")  # daemon supervision restarts it on b
+    time.sleep(0.3)
+    assert ask_sync(ref, "where", timeout=5.0, system=a)[0] == "sup"
+
+
+def test_remote_deploy_requires_recipe(two_systems):
+    a, b = two_systems
+    with pytest.raises(Exception):
+        a.actor_of(
+            Props.from_factory(lambda: WhereAmI()).with_deploy(
+                Deploy(scope=RemoteScope(addr_of(b)))),
+            "norecipe")
+
+
+def test_unregistered_class_is_refused(two_systems):
+    a, b = two_systems
+
+    class Local(Actor):  # not registered, defined inside a function
+        def receive(self, message):
+            self.sender.tell("hi", self.self_ref)
+
+    # origin side ships the recipe fine (class_key computed), but the wire
+    # codec itself refuses to encode args only when unregistered classes are
+    # used; the target daemon must refuse to import unregistered keys.
+    dead = []
+    from akka_tpu.actor.messages import DeadLetter
+    b.event_stream.subscribe(lambda e: dead.append(e), DeadLetter)
+    ref = a.actor_of(
+        Props.create(Local).with_deploy(Deploy(scope=RemoteScope(addr_of(b)))),
+        "refused")
+    time.sleep(0.3)
+    # never instantiated on b: asks time out / dead-letter reported
+    assert any("refusing to deploy" in repr(getattr(d, "message", ""))
+               for d in dead)
+
+
+def test_mangle_roundtrip_is_valid_path_element():
+    from akka_tpu.actor.path import validate_path_element
+    m = mangle("akka://sysA@local:1/user/worker#12345")
+    validate_path_element(m)  # must not raise
+
+
+# -- cluster-aware routers ----------------------------------------------------
+
+CLUSTER_FAST = {"akka": {"actor": {"provider": "cluster"},
+                         "stdout-loglevel": "OFF", "log-dead-letters": 0,
+                         "remote": {"transport": "inproc",
+                                    "canonical": {"hostname": "local",
+                                                  "port": 0}},
+                         "cluster": {"gossip-interval": "0.05s",
+                                     "leader-actions-interval": "0.05s",
+                                     "unreachable-nodes-reaper-interval": "0.2s",
+                                     "failure-detector": {
+                                         "heartbeat-interval": "0.1s",
+                                         "acceptable-heartbeat-pause": "2s"}}}}
+
+
+@pytest.fixture()
+def two_node_cluster():
+    InProcTransport.fault_injector.reset()
+    systems = [ActorSystem.create(f"crt{i}", CLUSTER_FAST) for i in range(2)]
+    clusters = [Cluster.get(s) for s in systems]
+    seed = str(systems[0].provider.local_address)
+    for c in clusters:
+        c.join(seed)
+    await_condition(
+        lambda: all(sum(1 for m in c.state.members
+                        if m.status is MemberStatus.UP) == 2
+                    for c in clusters),
+        max_time=10.0, message="2-node cluster did not form")
+    yield systems, clusters
+    for s in systems:
+        s.terminate()
+    for s in systems:
+        s.await_termination(10.0)
+    InProcTransport.fault_injector.reset()
+
+
+def _routee_count(system, router_ref):
+    return len(ask_sync(router_ref, GetRoutees(), timeout=5.0,
+                        system=system).routees)
+
+
+def test_cluster_router_pool_spans_nodes(two_node_cluster):
+    systems, clusters = two_node_cluster
+    a, b = systems
+    router = a.actor_of(
+        Props.create(WhereAmI, "pool").with_router(ClusterRouterPool(
+            RoundRobinPool(0),
+            ClusterRouterPoolSettings(total_instances=4,
+                                      max_instances_per_node=2))),
+        "span-pool")
+    await_condition(lambda: _routee_count(a, router) == 4, max_time=10.0,
+                    message="pool did not reach 4 routees over 2 nodes")
+    # routees actually run on BOTH systems
+    homes = set()
+    for _ in range(8):
+        _, sysname, _ = ask_sync(router, "where", timeout=5.0, system=a)
+        homes.add(sysname)
+    assert homes == {"crt0", "crt1"}
+
+
+def test_cluster_router_pool_respects_roles(two_node_cluster):
+    systems, clusters = two_node_cluster
+    a, b = systems
+    # nobody carries role "gpu" -> no routees beyond none
+    router = a.actor_of(
+        Props.create(WhereAmI).with_router(ClusterRouterPool(
+            RoundRobinPool(0),
+            ClusterRouterPoolSettings(total_instances=4,
+                                      max_instances_per_node=2,
+                                      use_roles=frozenset({"gpu"})))),
+        "role-pool")
+    time.sleep(0.5)
+    assert _routee_count(a, router) == 0
+
+
+def test_cluster_router_group_selects_remote_paths(two_node_cluster):
+    systems, clusters = two_node_cluster
+    a, b = systems
+    # a service instance on each node at the same path
+    for s in systems:
+        s.actor_of(Props.create(WhereAmI, f"svc-{s.name}"), "svc")
+    time.sleep(0.2)
+    router = a.actor_of(
+        Props.create(WhereAmI).with_router(ClusterRouterGroup(
+            RoundRobinGroup(["/user/svc"]),
+            ClusterRouterGroupSettings(total_instances=2,
+                                       routees_paths=("/user/svc",)))),
+        "span-group")
+    await_condition(lambda: _routee_count(a, router) == 2, max_time=10.0,
+                    message="group did not pick up both nodes")
+    homes = set()
+    for _ in range(6):
+        tag, sysname, _ = ask_sync(router, "where", timeout=5.0, system=a)
+        homes.add(sysname)
+    assert homes == {"crt0", "crt1"}
+
+
+def test_cluster_router_removes_downed_node(two_node_cluster):
+    systems, clusters = two_node_cluster
+    a, b = systems
+    router = a.actor_of(
+        Props.create(WhereAmI).with_router(ClusterRouterPool(
+            RoundRobinPool(0),
+            ClusterRouterPoolSettings(total_instances=2,
+                                      max_instances_per_node=1))),
+        "shrink-pool")
+    await_condition(lambda: _routee_count(a, router) == 2, max_time=10.0,
+                    message="pool did not fill")
+    clusters[0].down(str(b.provider.local_address))
+    await_condition(lambda: _routee_count(a, router) == 1, max_time=10.0,
+                    message="downed node's routee not removed")
+    # survivors all local now
+    for _ in range(3):
+        _, sysname, _ = ask_sync(router, "where", timeout=5.0, system=a)
+        assert sysname == "crt0"
