@@ -114,8 +114,13 @@ class ActorCell:
             child = self._children.get(name)
             if child is not None and child.path.uid == int(uid_s):
                 return child
-            return None
-        return self._children.get(name)
+            # remote-deployed children's paths carry no uid; a selection to
+            # the logical /user path must still resolve (the reference's
+            # children container holds the RemoteActorRef, so getChild finds
+            # it; skipping the uid check mirrors that)
+            return self._remote_children.get(name)
+        child = self._children.get(name)
+        return child if child is not None else self._remote_children.get(name)
 
     def actor_of(self, props: Props, name: Optional[str] = None) -> ActorRef:
         """Spawn a child (reference: dungeon/Children.attachChild →
@@ -139,8 +144,15 @@ class ActorCell:
                 # remote-deployed — it lives under the remote daemon, which
                 # watches this parent and stops the child when we die
                 # (remote/deploy.py; no local sysmsg channel exists for it),
-                # but it keeps its name here for uniqueness + child() lookup
+                # but it keeps its name here for uniqueness + child() lookup.
+                # Watch it (internal, NOT via self._watching, so the user
+                # never sees a Terminated they didn't ask for) so the entry
+                # is pruned when the remote child dies — otherwise the name
+                # stays reserved forever and the dict grows unboundedly
+                # under routee churn.
                 self._remote_children[name] = child
+                child.send_system_message(
+                    sysmsg.Watch(watchee=child, watcher=self.self_ref))
         child.start()
         return child
 
@@ -512,6 +524,20 @@ class ActorCell:
                                   cause: Optional[BaseException] = None) -> None:
         """(reference: dungeon/DeathWatch.watchedActorTerminated :81)"""
         name = actor.path.name
+        # remote-deployed child died: free its LOCAL name (the internal watch
+        # placed at spawn; mirrors how local children leave _children). The
+        # remote ref's path name is the daemon-side mangled name, so match by
+        # path value, lenient on uid like _find_watched.
+        from .path import undefined_uid
+        for rname, rref in list(self._remote_children.items()):
+            if rref.path == actor.path or (
+                    rref.path.address == actor.path.address
+                    and rref.path.elements == actor.path.elements
+                    and (rref.path.uid == undefined_uid
+                         or actor.path.uid == undefined_uid)):
+                with self._children_lock:
+                    self._remote_children.pop(rname, None)
+                break
         is_child = self._children.get(name) == actor
         if is_child:
             with self._children_lock:

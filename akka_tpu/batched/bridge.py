@@ -253,6 +253,15 @@ class BatchedRuntimeHandle:
                                     for c, v in init.items()}))
             self._spawn_inits = pruned
 
+    def generation_of(self, rows) -> np.ndarray:
+        """Incarnation generations for rows (pre-build rows are gen 0 —
+        nothing can have stopped yet). Does NOT force the runtime build."""
+        arr = np.atleast_1d(np.asarray(rows, np.int64))
+        with self._lock:
+            if self._runtime is None:
+                return np.zeros(arr.shape, np.int64)
+            return self._runtime.generation_of(arr)
+
     def read_state(self, col: str, rows=None) -> np.ndarray:
         """Read state columns without racing an in-flight step's buffer
         donation. Fetches the full column and indexes host-side: dynamic
@@ -287,6 +296,7 @@ class BatchedRuntimeHandle:
             mailbox_slots=self.mailbox_slots)
         if self.event_stream is not None:
             rt.on_dropped = self._publish_dropped
+            rt.on_dead_letter = self._publish_dead_letters
         rt.flight_recorder = self.flight_recorder
         for rec in self._spawns:
             got = rt.spawn_block(behaviors.index(rec.behavior), rec.n,
@@ -348,6 +358,10 @@ class BatchedRuntimeHandle:
         rt._host_staged = old._host_staged
         rt._lock = old._lock
         rt._dropped_host = old._dropped_host
+        # incarnation identity survives the swap (same rows, same history)
+        rt._generation = old._generation
+        rt.dead_lettered = old.dead_lettered
+        rt.on_dead_letter = old.on_dead_letter
         rt.warmup()
         self._runtime = rt
 
@@ -356,28 +370,43 @@ class BatchedRuntimeHandle:
         if es is not None:
             es.publish(DroppedDeviceMessages(n))
 
+    def _publish_dead_letters(self, n: int) -> None:
+        es = self.event_stream
+        if es is not None:
+            es.publish(DeviceDeadLetters(n))
+
     # ------------------------------------------------------------------- tell
     def tell(self, row: int, message: Any,
-             codec: Optional[MessageCodec] = None) -> None:
+             codec: Optional[MessageCodec] = None, expect_gen=None) -> None:
         mtype, payload = (codec or self.default_codec).encode(message)
         rt = self._ensure_runtime()
-        rt.tell(row, payload, mtype)
+        rt.tell(row, payload, mtype, expect_gen=expect_gen)
         self._pending_tells += 1
         self._wake_pump()
 
     def tell_rows(self, rows: np.ndarray, message: Any,
-                  codec: Optional[MessageCodec] = None) -> None:
+                  codec: Optional[MessageCodec] = None, expect_gen=None) -> None:
         mtype, payload = (codec or self.default_codec).encode(message)
         rt = self._ensure_runtime()
-        rt.tell(rows, payload, mtype)
+        rt.tell(rows, payload, mtype, expect_gen=expect_gen)
         self._pending_tells += 1
         self._wake_pump()
 
     # -------------------------------------------------------------------- ask
     def ask(self, row: int, message: Any, timeout: float = 5.0,
-            codec: Optional[MessageCodec] = None) -> Future:
-        self._ensure_runtime()
+            codec: Optional[MessageCodec] = None, expect_gen=None) -> Future:
+        rt0 = self._ensure_runtime()
         fut: Future = Future()
+        if expect_gen is not None and \
+                int(rt0.generation_of(row)[0]) != int(expect_gen):
+            # stale incarnation: fail fast instead of burning the timeout
+            # (AskSupport: ask to a terminated ref fails the future)
+            rt0.tell(row, np.zeros(self.payload_width, np.float32),
+                     expect_gen=expect_gen)  # count + publish the dead letter
+            fut.set_exception(RuntimeError(
+                f"ask to dead incarnation of device row {row} "
+                f"(expected gen {expect_gen})"))
+            return fut
         with self._lock:
             if not self._promise_free:
                 fut.set_exception(RuntimeError("promise rows exhausted"))
@@ -400,7 +429,10 @@ class BatchedRuntimeHandle:
             # jit compile time (20-40s on a cold TPU) never eats the ask
             # budget — the timeout measures device steps, not XLA compiles
             self._waiter_deadlines[prow] = (None, timeout)
-        rt.tell(row, payload, mtype)
+        # expect_gen rides to the STAGE-TIME check too: the entry check
+        # above fails fast, this closes the remaining TOCTOU window
+        # against a concurrent stop+respawn of the row
+        rt.tell(row, payload, mtype, expect_gen=expect_gen)
         self._wake_pump()
         return fut
 
@@ -650,20 +682,41 @@ class DroppedDeviceMessages:
         return f"DroppedDeviceMessages({self.count})"
 
 
+class DeviceDeadLetters:
+    """EventStream notification: tells dead-lettered because their pinned
+    incarnation generation no longer matches the row (the target was stopped
+    — and possibly respawned — after the ref was captured; uid-in-path
+    parity, ActorCell.scala:382-388)."""
+
+    __slots__ = ("count",)
+
+    def __init__(self, count: int):
+        self.count = count
+
+    def __repr__(self):
+        return f"DeviceDeadLetters({self.count})"
+
+
 # ------------------------------------------------------------------- the refs
 class DeviceActorRef(InternalActorRef):
     """An ActorRef whose mailbox is a device row. Watchable; tells after stop
-    go to dead letters (FunctionRef-pattern bookkeeping)."""
+    go to dead letters (FunctionRef-pattern bookkeeping). The ref pins the
+    row's incarnation GENERATION at creation (the reference's uid-in-path,
+    ActorCell.scala:382-388): a tell through a stale ref — the row was
+    stopped and the slot respawned — dead-letters instead of reaching the
+    new occupant."""
 
-    __slots__ = ("path", "_handle", "row", "_codec", "_system", "_stopped",
-                 "_watched_by", "_wlock")
+    __slots__ = ("path", "_handle", "row", "gen", "_codec", "_system",
+                 "_stopped", "_watched_by", "_wlock")
 
     def __init__(self, system, handle: BatchedRuntimeHandle, row: int, path,
-                 codec: Optional[MessageCodec] = None):
+                 codec: Optional[MessageCodec] = None, gen=None):
         self.path = path
         self._system = system
         self._handle = handle
         self.row = int(row)
+        self.gen = (int(gen) if gen is not None
+                    else int(handle.generation_of(row)[0]))
         self._codec = codec
         self._stopped = False
         self._watched_by: set = set()
@@ -674,10 +727,11 @@ class DeviceActorRef(InternalActorRef):
             self._system.dead_letters.tell(
                 DeadLetter(message, sender, self), sender)
             return
-        self._handle.tell(self.row, message, self._codec)
+        self._handle.tell(self.row, message, self._codec, expect_gen=self.gen)
 
     def ask(self, message: Any, timeout: float = 5.0) -> Future:
-        return self._handle.ask(self.row, message, timeout, self._codec)
+        return self._handle.ask(self.row, message, timeout, self._codec,
+                                expect_gen=self.gen)
 
     def ask_sync(self, message: Any, timeout: float = 5.0) -> Any:
         return self.ask(message, timeout).result(timeout + 1.0)
@@ -720,7 +774,7 @@ class DeviceBlockRef(InternalActorRef):
     every row (the bulk path — one staged batch, not n Python calls);
     `block[i]` derives the per-row ref."""
 
-    __slots__ = ("path", "_handle", "rows", "_codec", "_system")
+    __slots__ = ("path", "_handle", "rows", "gens", "_codec", "_system")
 
     def __init__(self, system, handle: BatchedRuntimeHandle, rows: np.ndarray,
                  path, codec: Optional[MessageCodec] = None):
@@ -728,6 +782,7 @@ class DeviceBlockRef(InternalActorRef):
         self._system = system
         self._handle = handle
         self.rows = rows
+        self.gens = handle.generation_of(rows)  # pinned incarnations
         self._codec = codec
 
     def __len__(self) -> int:
@@ -735,10 +790,12 @@ class DeviceBlockRef(InternalActorRef):
 
     def __getitem__(self, i: int) -> DeviceActorRef:
         return DeviceActorRef(self._system, self._handle, self.rows[i],
-                              self.path / str(i), self._codec)
+                              self.path / str(i), self._codec,
+                              gen=self.gens[i])
 
     def tell(self, message: Any, sender: Optional[ActorRef] = None) -> None:
-        self._handle.tell_rows(self.rows, message, self._codec)
+        self._handle.tell_rows(self.rows, message, self._codec,
+                               expect_gen=self.gens)
 
     def read_state(self, col: str) -> np.ndarray:
         return self._handle.read_state(col, self.rows)

@@ -57,7 +57,8 @@ class BatchedSystem:
                  device: Optional[Any] = None, delivery: str = "auto",
                  need_max: bool = False, topology=None,
                  mailbox_slots: int = 0,
-                 native_staging: Optional[bool] = None):
+                 native_staging: Optional[bool] = None,
+                 spill_capacity: Optional[int] = None):
         if not behaviors:
             raise ValueError("at least one behavior required")
         self.capacity = int(capacity)
@@ -74,6 +75,16 @@ class BatchedSystem:
         if self.mailbox_slots == 0 and any(b.inbox == "slots" for b in behaviors):
             # a slots behavior present => the whole system steps in slots mode
             self.mailbox_slots = max(2, self.out_degree)
+        # slots mode defaults to UNBOUNDED mailbox semantics (the reference's
+        # default, dispatch/Mailbox.scala:647): overflow past the S slots and
+        # suspended-row mail ride a spill region at the FRONT of the inbox
+        # and redeliver next step in FIFO order. spill_capacity=0 opts into
+        # bounded-mailbox drop-and-count semantics.
+        if self.mailbox_slots > 0:
+            self.spill_cap = (int(spill_capacity) if spill_capacity is not None
+                              else max(self.host_inbox, 4 * self.mailbox_slots))
+        else:
+            self.spill_cap = 0
 
         # unified state schema (union of behavior columns; conflicting specs are errors)
         self.state_spec: Dict[str, Tuple[Tuple[int, ...], Any]] = {}
@@ -96,7 +107,10 @@ class BatchedSystem:
         self.step_count = jnp.asarray(0, jnp.int32)
         self.mail_dropped = jnp.asarray(0, jnp.int32)  # mailbox-slot overflow
 
-        m = n * self.out_degree + self.host_inbox
+        # inbox layout: [spill_cap | n*K emissions | host_inbox] — spill
+        # first so redelivered (older) mail outranks fresh emissions in the
+        # stable (recipient, seq) delivery sort
+        m = self.spill_cap + n * self.out_degree + self.host_inbox
         self.inbox_dst = jnp.full((m,), -1, dtype=jnp.int32)
         self.inbox_type = jnp.zeros((m,), dtype=jnp.int32)
         self.inbox_payload = jnp.zeros((m, self.payload_width), dtype=payload_dtype)
@@ -107,6 +121,15 @@ class BatchedSystem:
         self._host_staged: List[Tuple[int, int, np.ndarray]] = []
         self._lock = threading.Lock()
         self._dropped_host = 0  # guarded by _lock; stager drops counted natively
+        # per-row incarnation counter (the reference's path uid,
+        # ActorCell.scala:382-388): bumped on stop, checked by tells that
+        # carry expect_gen — a tell aimed at a dead incarnation dead-letters
+        # instead of reaching the row's next occupant. Host-authoritative:
+        # generations only change on the host (spawn/stop are slow-path),
+        # so a host-side check at stage time is exact.
+        self._generation = np.zeros((n,), np.int64)
+        self.dead_lettered = 0  # generation-mismatch tells (guarded by _lock)
+        self.on_dead_letter: Optional[Callable[[int], None]] = None
         # overflow visibility hook (bounded-mailbox dead-letter parity,
         # dispatch/Mailbox.scala:415-443): the dispatcher bridge wires this
         # to the EventStream so host_inbox overflow surfaces as Dropped
@@ -159,7 +182,8 @@ class BatchedSystem:
                               out_degree=self.out_degree,
                               payload_dtype=payload_dtype,
                               slots=self.mailbox_slots, need_max=need_max,
-                              topology=topology, delivery=delivery)
+                              topology=topology, delivery=delivery,
+                              spill_cap=self.spill_cap)
 
         # topology tables ride as runtime arguments (pytree): closure
         # constants would be baked into the HLO (multi-MB programs break
@@ -178,10 +202,14 @@ class BatchedSystem:
         Fresh capacity is handed out contiguously; once the tail is
         exhausted, rows freed by stop_block are REUSED (free-list churn —
         SURVEY.md §7 hard parts: spawn/stop via free-lists). Reused rows
-        get zeroed state and their stale inbox slots scrubbed; note there
-        is no per-row uid, so a tell raced exactly against stop+respawn of
-        the same row can reach the new occupant (the reference guards this
-        with path uids, ActorCell.scala:382-388). Returns the global ids."""
+        get zeroed state and their stale inbox slots scrubbed. Incarnation
+        identity is guarded by the per-row generation counter (the
+        reference's path uid, ActorCell.scala:382-388): capture it with
+        `generation_of(ids)` and pass `expect_gen` to tell() — a tell
+        raced against stop+respawn of the same row then dead-letters
+        instead of reaching the new occupant (stop bumps the generation;
+        the stage-time check plus this method's scrub of staged/in-flight
+        messages closes the window). Returns the global ids."""
         b_idx = behavior if isinstance(behavior, int) else self.behaviors.index(behavior)
         with self._lock:
             start = self._next_row
@@ -248,19 +276,53 @@ class BatchedSystem:
 
     def stop_block(self, ids: np.ndarray) -> None:
         """Mark actors dead and recycle their rows (their rows stop
-        updating and emitting; capacity is reclaimed for future spawns)."""
+        updating and emitting; capacity is reclaimed for future spawns).
+        Bumps the rows' incarnation generation so stale expect_gen tells
+        dead-letter (ActorCell.scala:382-388 uid parity)."""
         arr = np.unique(np.atleast_1d(np.asarray(ids, np.int32)))
         self.alive = self.alive.at[jnp.asarray(arr)].set(False)
         with self._lock:
+            self._generation[arr] += 1
             seen = set(self._free_rows)
             self._free_rows.extend(int(i) for i in arr if int(i) not in seen)
 
+    def generation_of(self, ids) -> np.ndarray:
+        """Current incarnation generation of the given rows (capture at
+        spawn; pass to tell(expect_gen=...) to pin the incarnation)."""
+        arr = np.atleast_1d(np.asarray(ids, np.int64))
+        with self._lock:
+            return self._generation[arr].copy()
+
     # ------------------------------------------------------------------ tell
-    def tell(self, dst, payload, mtype: int = 0) -> None:
+    def tell(self, dst, payload, mtype: int = 0, expect_gen=None) -> None:
         """Host-side tell: staged, flushed into the inbox on next step.
         dst: int or [k] array; payload: [P] or [k, P]; mtype: message-type
-        tag (int or [k] array) delivered in slots mode."""
+        tag (int or [k] array) delivered in slots mode. expect_gen (int or
+        [k] array): the sender's captured incarnation generation — a
+        mismatch (the row was stopped, possibly respawned, since capture)
+        dead-letters the message instead of delivering it to the wrong
+        occupant (path-uid parity, ActorCell.scala:382-388)."""
         dst_arr = np.atleast_1d(np.asarray(dst, dtype=np.int32))
+        if expect_gen is not None:
+            gens = np.broadcast_to(
+                np.atleast_1d(np.asarray(expect_gen, np.int64)),
+                dst_arr.shape)
+            with self._lock:
+                ok = self._generation[dst_arr] == gens
+            if not ok.all():
+                n_dead = int((~ok).sum())
+                with self._lock:
+                    self.dead_lettered += n_dead
+                if self.on_dead_letter is not None:
+                    self.on_dead_letter(n_dead)
+                if not ok.any():
+                    return
+                dst_arr = dst_arr[ok]
+                payload = np.asarray(payload, dtype=self._np_payload_dtype)
+                if payload.ndim > 1:
+                    payload = payload[ok]
+                if np.ndim(mtype) > 0:
+                    mtype = np.asarray(mtype, np.int32)[ok]
         pl = np.asarray(payload, dtype=self._np_payload_dtype)
         if pl.ndim == 1:
             # broadcast a single payload row to every destination — the
@@ -323,7 +385,7 @@ class BatchedSystem:
                     dsts, mts, pls, valid):
         """One static-shape program: overwrite the host region of the inbox.
         [host_inbox]-shaped args regardless of how many tells are staged."""
-        base = self.capacity * self.out_degree
+        base = self.spill_cap + self.capacity * self.out_degree
         upd = jax.lax.dynamic_update_slice
         return (upd(inbox_dst, dsts, (base,)),
                 upd(inbox_type, mts, (base,)),
@@ -383,25 +445,36 @@ class BatchedSystem:
                    inbox_payload, inbox_valid, mail_dropped, step_count,
                    topo_arrays=()):
         n = self.capacity
+        sc = self.spill_cap
         nk = n * self.out_degree
-        new_state, behavior_id, emits, dropped = self._core.run_local(
+        new_state, behavior_id, emits, dropped, spill = self._core.run_local(
             state, behavior_id, alive, inbox_dst, inbox_type, inbox_payload,
             inbox_valid, step_count, topo_arrays)
 
-        # write emissions in place over the donated inbox buffers (the first
-        # n*K rows are exactly the emission slots; host rows are cleared) —
-        # no per-step concatenate/realloc (VERDICT r1 weak #2)
+        # write emissions in place over the donated inbox buffers (rows
+        # [sc, sc+n*K) are exactly the emission slots; retained spill goes
+        # FIRST; host rows are cleared) — no per-step concatenate/realloc
+        # (VERDICT r1 weak #2)
         out_dst = emits.dst.reshape(-1)
         out_payload = emits.payload.reshape(-1, self.payload_width)
         out_valid = emits.valid.reshape(-1)
-        new_inbox_dst = inbox_dst.at[:nk].set(out_dst).at[nk:].set(-1)
+        upd = jax.lax.dynamic_update_slice
+        new_inbox_dst = upd(inbox_dst, out_dst, (sc,)).at[sc + nk:].set(-1)
         if self.mailbox_slots > 0:
             out_type = emits.type.reshape(-1)
-            new_inbox_type = inbox_type.at[:nk].set(out_type).at[nk:].set(0)
+            new_inbox_type = upd(inbox_type, out_type, (sc,)).at[sc + nk:].set(0)
         else:
             new_inbox_type = inbox_type  # never read in reduce mode
-        new_inbox_payload = inbox_payload.at[:nk].set(out_payload).at[nk:].set(0)
-        new_inbox_valid = inbox_valid.at[:nk].set(out_valid).at[nk:].set(False)
+        new_inbox_payload = upd(inbox_payload, out_payload,
+                                (sc, 0)).at[sc + nk:].set(0)
+        new_inbox_valid = upd(inbox_valid, out_valid,
+                              (sc,)).at[sc + nk:].set(False)
+        if spill is not None:  # spill is None iff sc == 0
+            sp_dst, sp_type, sp_pl, sp_v = spill
+            new_inbox_dst = new_inbox_dst.at[:sc].set(sp_dst)
+            new_inbox_type = new_inbox_type.at[:sc].set(sp_type)
+            new_inbox_payload = new_inbox_payload.at[:sc].set(sp_pl)
+            new_inbox_valid = new_inbox_valid.at[:sc].set(sp_v)
         return (new_state, behavior_id, alive, new_inbox_dst, new_inbox_type,
                 new_inbox_payload, new_inbox_valid, mail_dropped + dropped,
                 step_count + 1)
@@ -540,8 +613,11 @@ class BatchedSystem:
 
     @property
     def mailbox_overflow(self) -> int:
-        """Messages dropped on device because a recipient's mailbox slots
-        were full (slots mode only; bounded-mailbox overflow)."""
+        """Messages LOST on device (slots mode only). With the default
+        spill region, slot overflow is retained and redelivered — this
+        counts only spill-region overflow (a sustained burst larger than
+        spill_capacity). With spill_capacity=0 (bounded mailboxes), every
+        message past the S slots counts (dispatch/Mailbox.scala:415-443)."""
         return int(jax.device_get(self.mail_dropped))
 
     @property

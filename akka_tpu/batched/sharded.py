@@ -47,7 +47,8 @@ class ShardedBatchedSystem:
                  host_inbox_per_shard: int = 256,
                  remote_capacity_per_pair: Optional[int] = None,
                  payload_dtype=jnp.float32, axis_name: str = "shards",
-                 mailbox_slots: int = 0, reroute_strays: bool = False):
+                 mailbox_slots: int = 0, reroute_strays: bool = False,
+                 spill_capacity: Optional[int] = None):
         self.mesh = mesh if mesh is not None else make_mesh(n_devices, axis_name)
         self.axis = axis_name
         self.n_shards = self.mesh.shape[axis_name]
@@ -63,6 +64,15 @@ class ShardedBatchedSystem:
         self.mailbox_slots = int(mailbox_slots)
         if self.mailbox_slots == 0 and any(b.inbox == "slots" for b in behaviors):
             self.mailbox_slots = max(2, out_degree)
+        # per-shard spill region: unbounded-mailbox semantics in slots mode
+        # (overflow + suspended-row mail retained, redelivered next step
+        # ahead of fresh traffic — see BatchedSystem)
+        if self.mailbox_slots > 0:
+            self.spill_cap = (int(spill_capacity) if spill_capacity is not None
+                              else max(self.host_inbox,
+                                       4 * self.mailbox_slots))
+        else:
+            self.spill_cap = 0
         # forward inbox messages whose home shard moved (rebalance) one
         # more hop instead of dropping them; costs a larger bucketing sort
         self.reroute_strays = bool(reroute_strays)
@@ -95,8 +105,10 @@ class ShardedBatchedSystem:
         self.alive = jax.device_put(jnp.zeros((n,), jnp.bool_), shard)
         self.step_count = jnp.asarray(0, jnp.int32)
 
-        # inbox per shard: D*C exchange slots + host slots
-        self.m_local = self.n_shards * self.pair_cap + self.host_inbox
+        # inbox per shard: spill slots first (older mail outranks fresh in
+        # the stable delivery sort), then D*C exchange slots, then host slots
+        self.m_local = self.spill_cap + self.n_shards * self.pair_cap \
+            + self.host_inbox
         m_global = self.m_local * self.n_shards
         self.inbox_dst = jax.device_put(jnp.full((m_global,), -1, jnp.int32), shard)
         self.inbox_type = jax.device_put(jnp.zeros((m_global,), jnp.int32), shard)
@@ -120,7 +132,8 @@ class ShardedBatchedSystem:
                               out_degree=out_degree,
                               payload_dtype=payload_dtype,
                               slots=self.mailbox_slots,
-                              n_global=self.capacity)
+                              n_global=self.capacity,
+                              spill_cap=self.spill_cap)
         self._step_fn = None  # built lazily: tables may be set post-init
 
     # -------------------------------------------------------------- builders
@@ -138,7 +151,7 @@ class ShardedBatchedSystem:
             shard_idx = jax.lax.axis_index(axis)
             base = shard_idx * n_local
 
-            new_state, behavior_id, emits, mdrop = core.run_local(
+            new_state, behavior_id, emits, mdrop, spill = core.run_local(
                 state, behavior_id, alive, inbox_dst, inbox_type,
                 inbox_payload, inbox_valid, step_count,
                 dst_offset=base, id_base=base, tables=tables)
@@ -206,10 +219,13 @@ class ShardedBatchedSystem:
             recv_ok = jax.lax.all_to_all(
                 buf_ok.reshape(n_shards, pair_cap), axis, 0, 0, tiled=False).reshape(-1)
 
-            # write received chunks in place over the donated inbox block;
-            # host rows (the tail) are cleared
+            # write received chunks in place over the donated inbox block
+            # at the exchange offset (after the spill region); host rows
+            # (the tail) are cleared; retained spill lands FIRST
+            sc = self.spill_cap
             r = recv_dst.shape[0]
-            new_inbox_dst = inbox_dst.at[:r].set(recv_dst).at[r:].set(-1)
+            upd = jax.lax.dynamic_update_slice
+            new_inbox_dst = upd(inbox_dst, recv_dst, (sc,)).at[sc + r:].set(-1)
             if slots_mode:
                 # the type column rides the exchange only when somebody
                 # reads it — reduce-mode systems skip a whole collective
@@ -219,11 +235,20 @@ class ShardedBatchedSystem:
                 recv_type = jax.lax.all_to_all(
                     buf_type.reshape(n_shards, pair_cap), axis, 0, 0,
                     tiled=False).reshape(-1)
-                new_inbox_type = inbox_type.at[:r].set(recv_type).at[r:].set(0)
+                new_inbox_type = upd(inbox_type, recv_type,
+                                     (sc,)).at[sc + r:].set(0)
             else:
                 new_inbox_type = inbox_type  # never read in reduce mode
-            new_inbox_payload = inbox_payload.at[:r].set(recv_pl).at[r:].set(0)
-            new_inbox_valid = inbox_valid.at[:r].set(recv_ok).at[r:].set(False)
+            new_inbox_payload = upd(inbox_payload, recv_pl,
+                                    (sc, 0)).at[sc + r:].set(0)
+            new_inbox_valid = upd(inbox_valid, recv_ok,
+                                  (sc,)).at[sc + r:].set(False)
+            if spill is not None:  # spill is None iff sc == 0
+                sp_dst, sp_type, sp_pl, sp_v = spill
+                new_inbox_dst = new_inbox_dst.at[:sc].set(sp_dst)
+                new_inbox_type = new_inbox_type.at[:sc].set(sp_type)
+                new_inbox_payload = new_inbox_payload.at[:sc].set(sp_pl)
+                new_inbox_valid = new_inbox_valid.at[:sc].set(sp_v)
             new_dropped = dropped + n_dropped
             new_mail_dropped = mail_dropped + mdrop
 
@@ -296,7 +321,8 @@ class ShardedBatchedSystem:
             if u >= self.host_inbox:
                 continue
             per_shard_used[s] = u + 1
-            idxs.append(s * self.m_local + self.n_shards * self.pair_cap + u)
+            idxs.append(s * self.m_local + self.spill_cap
+                        + self.n_shards * self.pair_cap + u)
             dsts.append(d)
             mts.append(t)
             pls.append(p)

@@ -43,7 +43,8 @@ class StepCore:
     def __init__(self, behaviors: Sequence[BatchedBehavior], n_local: int,
                  payload_width: int, out_degree: int, payload_dtype,
                  slots: int = 0, need_max: bool = False, topology=None,
-                 delivery: str = "auto", n_global: Optional[int] = None):
+                 delivery: str = "auto", n_global: Optional[int] = None,
+                 spill_cap: int = 0):
         self.behaviors = list(behaviors)
         self.n_local = int(n_local)
         self.n_global = int(n_global if n_global is not None else n_local)
@@ -54,6 +55,9 @@ class StepCore:
         self.need_max = need_max
         self.topology = topology
         self.delivery = delivery
+        # spill region size (slots mode): overflow + suspended-row mail is
+        # retained there instead of dropped (unbounded-mailbox semantics)
+        self.spill_cap = int(spill_cap)
 
         if self.slots == 0:
             bad = [b.name for b in self.behaviors if b.inbox == "slots"]
@@ -110,7 +114,8 @@ class StepCore:
 
     # ------------------------------------------------------------- deliver
     def deliver(self, inbox_dst, inbox_type, inbox_payload, inbox_valid,
-                topo_arrays=(), dst_offset=None):
+                topo_arrays=(), dst_offset=None, slots_kind_row=None,
+                suspended=None):
         """Route this step's messages into per-actor inboxes. dst_offset
         (traced scalar) maps global recipient ids to local rows (sharded
         callers pass shard_base; single-device callers pass None)."""
@@ -118,7 +123,10 @@ class StepCore:
         dst = inbox_dst if dst_offset is None else inbox_dst - dst_offset
         if self.slots > 0:
             return deliver_slots(dst, inbox_type, inbox_payload, inbox_valid,
-                                 n, self.slots, self.need_max)
+                                 n, self.slots, self.need_max,
+                                 spill_cap=self.spill_cap,
+                                 slots_kind=slots_kind_row,
+                                 suspended=suspended)
         if self.topology is not None:
             nk = self.n_local * self.out_degree
             d = deliver_static(self.topology, topo_arrays,
@@ -183,8 +191,10 @@ class StepCore:
                       tables=tables)
             # an already-failed row is suspended: no update, no emissions,
             # until the host restarts it (FaultHandling.suspend parity —
-            # actor/dungeon/FaultHandling.scala; messages arriving while
-            # suspended are dropped, unlike the reference's queued mailbox)
+            # actor/dungeon/FaultHandling.scala). In slots mode with a spill
+            # region its mail is RETAINED (spilled, redelivered after
+            # restart — the reference's queued-while-suspended semantics);
+            # in reduce mode / spill_cap == 0 it is dropped (deviation)
             was_failed = state_row.get("_failed", jnp.asarray(False))
             live = alive_i & ~was_failed
             new_state, emit = jax.lax.switch(b_id, branches, state_row,
@@ -225,20 +235,39 @@ class StepCore:
                   inbox_payload, inbox_valid, step_count, topo_arrays=(),
                   dst_offset=None, id_base=0, tables=()):
         """deliver + update in one call. Returns (new_state, new_behavior_id,
-        emits, dropped) where dropped is this step's mailbox-overflow count
-        (0 in reduce mode — reductions never overflow)."""
+        emits, dropped, spill) where dropped is this step's REAL message-loss
+        count (0 in reduce mode — reductions never overflow; spill-region
+        overflow in slots mode) and spill is a (dst, type, payload, valid)
+        tuple of retained mail to re-inject at the FRONT of the next inbox
+        (spill dst is GLOBAL — dst_offset re-applied), or None when
+        spill_cap == 0."""
+        slots_kind_row = suspended = None
+        if self.slots > 0 and self.spill_cap > 0:
+            slots_kind_row = self._slots_kind[behavior_id]
+            if "_failed" in state:
+                # suspended = failed-but-restartable; dead rows' mail is
+                # discarded as before (no resurrection to wait for)
+                suspended = state["_failed"] & alive
         d = self.deliver(inbox_dst, inbox_type, inbox_payload, inbox_valid,
-                         topo_arrays, dst_offset)
+                         topo_arrays, dst_offset, slots_kind_row, suspended)
         new_state, new_behavior_id, emits = self.update(
             state, behavior_id, alive, d, step_count, id_base, tables)
-        if self.slots > 0:
-            # per-recipient overflow, masked to slots-kind recipients
+        spill = None
+        if self.slots > 0 and self.spill_cap > 0:
+            sd = d.spill_dst
+            if dst_offset is not None:
+                sd = jnp.where(d.spill_valid, sd + dst_offset, -1)
+            spill = (sd, d.spill_type, d.spill_payload, d.spill_valid)
+            dropped = d.dropped
+        elif self.slots > 0:
+            # bounded mailbox: per-recipient overflow, masked to slots-kind
+            # recipients (reduce-kind consume everything via aggregation)
             over = jnp.maximum(d.count - self.slots, 0)
             dropped = jnp.sum(jnp.where(self._slots_kind[behavior_id],
                                         over, 0)).astype(jnp.int32)
         else:
             dropped = jnp.asarray(0, jnp.int32)
-        return new_state, new_behavior_id, emits, dropped
+        return new_state, new_behavior_id, emits, dropped, spill
 
 
 # -------------------------------------------------- shared fault handling

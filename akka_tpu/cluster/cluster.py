@@ -70,6 +70,25 @@ class Cluster:
         self._member_up_fired = False
         self._removed_event = threading.Event()
 
+        # join-time configuration compatibility (reference:
+        # JoinConfigCompatChecker.scala:18 — a configurable set of
+        # cluster-critical paths is digested; the contact node compares)
+        compat = cfg.get_config("configuration-compatibility-check")
+        self.enforce_config_compat = compat.get_bool("enforce-on-join", True)
+        self.config_compat_paths = tuple(
+            compat.get("sensitive-config-paths", None) or (
+                "downing-provider-class",
+                "split-brain-resolver.active-strategy",
+                "allow-weakly-up-members",
+            ))
+        import hashlib as _hashlib
+        import json as _json
+        snapshot = {p: cfg.get(p, None) for p in self.config_compat_paths}
+        self.config_digest = _hashlib.sha256(
+            _json.dumps(snapshot, sort_keys=True, default=str)
+            .encode()).hexdigest()
+        self.join_refused_reason: Optional[str] = None
+
         self.daemon = system.system_actor_of(
             Props.create(ClusterCoreDaemon, self), "cluster")
 
@@ -82,7 +101,9 @@ class Cluster:
         if provider == "sbr" or active not in ("", "off"):
             self.sbr = system.system_actor_of(
                 Props.create(SplitBrainResolver, self,
-                             strategy_from_config(sbr_cfg),
+                             strategy_from_config(
+                                 sbr_cfg, system=system,
+                                 self_owner=str(self.self_unique_address)),
                              sbr_cfg.get_duration("stable-after", "20s")),
                 "split-brain-resolver")
         else:

@@ -33,6 +33,17 @@ from .member import Member, MemberStatus, UniqueAddress
 class Join:
     node: UniqueAddress
     roles: FrozenSet[str] = frozenset()
+    # digest of the joiner's cluster-critical config; the first contact
+    # node refuses mismatches (reference: JoinConfigCompatChecker.scala:18)
+    config_digest: str = ""
+
+
+@dataclass(frozen=True)
+class JoinRefused:
+    """Join denied — incompatible configuration (the reference replies
+    IncompatibleConfig and the joiner logs + gives up)."""
+    from_node: UniqueAddress
+    reason: str
 
 
 @dataclass(frozen=True)
@@ -149,7 +160,10 @@ class ClusterCoreDaemon(Actor):
         elif isinstance(message, _HeartbeatTick):
             self._heartbeat_tick()
         elif isinstance(message, Join):
-            self._joining(message.node, message.roles)
+            self._joining(message.node, message.roles,
+                          getattr(message, "config_digest", ""))
+        elif isinstance(message, JoinRefused):
+            self._join_refused(message)
         elif isinstance(message, Welcome):
             self._welcome(message)
         elif isinstance(message, GossipEnvelope):
@@ -217,11 +231,27 @@ class ClusterCoreDaemon(Actor):
                 self._publish_changes()
             self._stop_join_retry()
         else:
-            self._send_to_addr(address_str, Join(self.self_node, self.roles))
+            self._send_to_addr(address_str,
+                               Join(self.self_node, self.roles,
+                                    self.cluster.config_digest))
 
-    def _joining(self, node: UniqueAddress, roles: FrozenSet[str]) -> None:
+    def _joining(self, node: UniqueAddress, roles: FrozenSet[str],
+                 config_digest: str = "") -> None:
         if not self.gossip.has_member(self.self_node):
             return  # not yet a member ourselves; joiner will retry
+        # configuration compatibility check at first contact (reference:
+        # JoinConfigCompatChecker.scala:18 + ClusterDaemon joining's
+        # validateJoin): a node with incompatible cluster-critical config
+        # is refused with a logged reason, never admitted
+        if (self.cluster.enforce_config_compat and config_digest
+                and config_digest != self.cluster.config_digest):
+            reason = (f"incompatible cluster configuration from {node}: "
+                      f"digest {config_digest[:12]} != "
+                      f"{self.cluster.config_digest[:12]} over "
+                      f"{self.cluster.config_compat_paths}")
+            self._log_warning(reason)
+            self._send_to(node, JoinRefused(self.self_node, reason))
+            return
         existing = self.gossip.member(node)
         if existing is not None and existing.status is not MemberStatus.REMOVED:
             self._send_to(node, Welcome(self.self_node, self.gossip))
@@ -236,6 +266,20 @@ class ClusterCoreDaemon(Actor):
                        .only_seen_by(self.self_node))
         self._publish_changes()
         self._send_to(node, Welcome(self.self_node, self.gossip))
+
+    def _join_refused(self, msg: JoinRefused) -> None:
+        """The contact node rejected our config: log loudly and STOP
+        retrying (an operator must fix the config; hammering the seed with
+        doomed joins helps nobody)."""
+        self._log_warning(
+            f"join refused by {msg.from_node}: {msg.reason}")
+        self._stop_join_retry()
+        self.cluster.join_refused_reason = msg.reason
+
+    def _log_warning(self, text: str) -> None:
+        from ..event.logging import Warning as _Warning
+        self.context.system.event_stream.publish(
+            _Warning(str(self.self_ref.path), "ClusterCoreDaemon", text))
 
     def _welcome(self, w: Welcome) -> None:
         if not w.gossip.has_member(self.self_node):

@@ -40,6 +40,14 @@ class ClusterRouterPoolSettings:
     allow_local_routees: bool = True
     use_roles: frozenset = frozenset()
 
+    def __post_init__(self):
+        # reference throws IllegalArgumentException for both
+        if self.total_instances <= 0:
+            raise ValueError("total_instances of cluster router must be > 0")
+        if self.max_instances_per_node <= 0:
+            raise ValueError(
+                "max_instances_per_node of cluster router must be > 0")
+
 
 @dataclass(frozen=True)
 class ClusterRouterGroupSettings:
@@ -48,6 +56,10 @@ class ClusterRouterGroupSettings:
     routees_paths: Tuple[str, ...] = ()
     allow_local_routees: bool = True
     use_roles: frozenset = frozenset()
+
+    def __post_init__(self):
+        if self.total_instances <= 0:
+            raise ValueError("total_instances of cluster router must be > 0")
 
 
 @dataclass(frozen=True)
@@ -133,6 +145,11 @@ class ClusterRouterActor(RouterActor):
     def _eligible(self, member: Member) -> bool:
         if member.status not in (MemberStatus.UP, MemberStatus.WEAKLY_UP):
             return False
+        # never (re)deploy onto a node currently marked unreachable — the
+        # reference's availableNodes excludes them; without this, the
+        # backfill after _remove_node would put routees straight back
+        if member in self.cluster.state.unreachable:
+            return False
         roles = frozenset(self.settings.use_roles)
         if roles and not roles.issubset(member.roles):
             return False
@@ -149,39 +166,42 @@ class ClusterRouterActor(RouterActor):
         total = sum(len(v) for v in self.node_routees.values())
         return max(self.settings.total_instances - total, 0)
 
-    def _add_member(self, member: Member) -> None:
-        """Idempotent top-up: brings this node to its per-node quota (bounded
-        by total_instances), so backfill after routee loss works too."""
-        if not self._eligible(member):
-            return
-        addr = self._member_addr(member)
-        cell = self._rcell
-        is_self = (member.unique_address == self.cluster.self_unique_address)
-        existing = self.node_routees.get(addr, [])
-        created: List[Routee] = []
+    def _node_limit(self) -> int:
         if self.router_config.is_group:
-            want = self.settings.routees_paths[len(existing):]
-            for path in want:
-                if self._capacity_left() - len(created) <= 0:
-                    break
-                # full address form even for self: the provider resolves our
-                # own address back to local refs (provider.resolve_actor_ref)
-                created.append(ActorSelectionRoutee(f"{addr}{path}",
-                                                    self.context.system))
+            return len(self.settings.routees_paths)
+        return self.settings.max_instances_per_node
+
+    def _add_one(self, member: Member) -> bool:
+        """Deploy exactly one routee onto `member`'s node. False when the
+        node is already at its per-node limit or total capacity is hit."""
+        addr = self._member_addr(member)
+        existing = self.node_routees.get(addr, [])
+        if len(existing) >= self._node_limit() or self._capacity_left() <= 0:
+            return False
+        cell = self._rcell
+        if self.router_config.is_group:
+            path = self.settings.routees_paths[len(existing)]
+            # full address form even for self: the provider resolves our
+            # own address back to local refs (provider.resolve_actor_ref)
+            r: Routee = ActorSelectionRoutee(f"{addr}{path}",
+                                             self.context.system)
         else:
-            per_node = min(self.settings.max_instances_per_node,
-                           len(existing) + self._capacity_left())
-            for _ in range(per_node - len(existing)):
-                props = cell.routee_props
-                if not is_self:
-                    props = props.with_deploy(Deploy(scope=RemoteScope(addr)))
-                child = cell.actor_of(props)
-                self.context.watch(child)
-                created.append(ActorRefRoutee(child))
-        if created:
-            self.node_routees[addr] = list(existing) + created
-            for r in created:
-                cell.router.add_routee(r)
+            is_self = (member.unique_address == self.cluster.self_unique_address)
+            props = cell.routee_props
+            if not is_self:
+                props = props.with_deploy(Deploy(scope=RemoteScope(addr)))
+            child = cell.actor_of(props)
+            self.context.watch(child)
+            r = ActorRefRoutee(child)
+        self.node_routees.setdefault(addr, []).append(r)
+        cell.router.add_routee(r)
+        return True
+
+    def _add_member(self, member: Member) -> None:
+        """A node became usable: resume filling (the reference's addMember
+        registers the node then deploys via selectDeploymentTarget)."""
+        if self._eligible(member):
+            self._fill()
 
     def _remove_node(self, addr: str) -> None:
         routees = self.node_routees.pop(addr, None)
@@ -197,20 +217,29 @@ class ClusterRouterActor(RouterActor):
         # backfill onto remaining nodes (fully-filled check parity)
         self._fill()
 
-    def _fill(self) -> None:
-        state = self.cluster.state
-        for m in sorted(state.members, key=lambda m: self._member_addr(m)):
-            if self._capacity_left() <= 0:
-                break
-            self._add_member(m)
+    def _fill(self, members=None) -> None:
+        """Allocate one routee at a time onto the currently LEAST-LOADED
+        eligible node (ties broken by address for determinism) until total
+        capacity or every node's per-node limit is reached — the reference's
+        ClusterRouterPoolActor.selectDeploymentTarget order, which spreads
+        routees one-per-node instead of packing the lexicographically
+        smallest addresses first."""
+        if members is None:
+            members = self.cluster.state.members
+        eligible = [m for m in members if self._eligible(m)]
+        while self._capacity_left() > 0 and eligible:
+            target = min(eligible, key=lambda m: (
+                len(self.node_routees.get(self._member_addr(m), ())),
+                self._member_addr(m)))
+            if not self._add_one(target):
+                eligible.remove(target)  # node at per-node limit
 
     # -- receive -------------------------------------------------------------
     def receive(self, message: Any):
         if isinstance(message, _ClusterEvent):
             message = message.event
         if isinstance(message, CurrentClusterState):
-            for m in message.members:
-                self._add_member(m)
+            self._fill(message.members)
             return None
         if isinstance(message, (MemberUp, MemberWeaklyUp)):
             self._add_member(message.member)

@@ -100,7 +100,42 @@ class DownAll(DowningStrategy):
         return Decision([m.unique_address for m in members])
 
 
-def strategy_from_config(cfg) -> DowningStrategy:
+class LeaseMajority(DowningStrategy):
+    """The side that ACQUIRES the lease survives (reference:
+    SplitBrainResolver.scala:45-55 acquire/release plumbing +
+    DowningStrategy.LeaseMajority): only each side's lowest-address
+    reachable node races for the lease — on success it downs the other
+    side, on failure it downs its OWN side; the rest of its side follows
+    the downing through gossip. Works across real processes with the
+    `file` lease backend."""
+
+    def __init__(self, lease_factory):
+        # factory: () -> Lease — deferred so the owner name can carry the
+        # node address and the lease is only created when SBR fires
+        self._lease_factory = lease_factory
+        self._lease = None
+
+    def decide(self, members, unreachable, self_node):
+        reachable, lost = self._sides(members, unreachable)
+        if not lost or not reachable:
+            return Decision([])
+        decider = min(m.unique_address for m in reachable)
+        if self_node != decider:
+            return Decision([])  # our side's decider acts; downs gossip in
+        if self._lease is None:
+            self._lease = self._lease_factory()
+        if self._lease.acquire():
+            return self._down_side(lost)
+        return self._down_side(reachable)
+
+    def release(self) -> None:
+        if self._lease is not None:
+            self._lease.release()
+
+
+def strategy_from_config(cfg, system=None, self_owner: str = ""
+                         ) -> DowningStrategy:
+    """(reference: SplitBrainResolver.scala:536 strategy selection)"""
     name = cfg.get_string("active-strategy", "keep-majority")
     if name == "keep-majority":
         return KeepMajority()
@@ -110,6 +145,19 @@ def strategy_from_config(cfg) -> DowningStrategy:
         return KeepOldest(cfg.get_bool("keep-oldest.down-if-alone", True))
     if name == "down-all":
         return DownAll()
+    if name == "lease-majority":
+        if system is None:
+            raise ValueError("lease-majority needs the actor system")
+        lease_name = cfg.get_string(
+            "lease-majority.lease-name",
+            f"{system.name}-akka-sbr")
+
+        def factory():
+            from ..cluster_tools.lease import LeaseProvider
+            return LeaseProvider.get(system).get_lease(
+                lease_name, "akka.cluster.split-brain-resolver.lease-majority",
+                self_owner)
+        return LeaseMajority(factory)
     raise ValueError(f"unknown split-brain-resolver strategy {name!r}")
 
 

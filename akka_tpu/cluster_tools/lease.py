@@ -141,8 +141,130 @@ class InProcLease(Lease):
                     and rec.deadline > time.monotonic())
 
 
+class FileLease(Lease):
+    """Cross-PROCESS lease backed by an atomically-created lock file with a
+    TTL (the single-host analogue of a Kubernetes-lease backend; used by the
+    real-process SBR lease-majority tests). Layout: one JSON file per lease
+    name under `FileLease.directory` holding {owner, deadline} (wall clock).
+
+    Every read-check-write cycle (acquire, heartbeat, release) runs under
+    an exclusive flock on a sibling .lock file, so contention — including
+    two processes racing to take over an EXPIRED lease — has exactly one
+    winner; the lease file itself is rewritten via tmp+rename (atomic)."""
+
+    directory: str = "/tmp/akka-tpu-leases"
+
+    def __init__(self, settings: LeaseSettings):
+        super().__init__(settings)
+        self._heartbeat_task: Optional[threading.Timer] = None
+
+    def _path(self) -> str:
+        import os
+        import re
+        os.makedirs(FileLease.directory, exist_ok=True)
+        safe = re.sub(r"[^\w.-]", "_", self.settings.lease_name)
+        return os.path.join(FileLease.directory, safe + ".lease")
+
+    def _ttl(self) -> float:
+        return self.settings.timeout.heartbeat_timeout
+
+    class _flocked:
+        """Exclusive advisory lock over the lease's critical sections —
+        the cross-process mutex that makes read-check-write atomic."""
+
+        def __init__(self, path: str):
+            self._path = path + ".lock"
+            self._f = None
+
+        def __enter__(self):
+            import fcntl
+            self._f = open(self._path, "a+")  # noqa: SIM115 — held past scope
+            fcntl.flock(self._f, fcntl.LOCK_EX)
+            return self
+
+        def __exit__(self, *exc):
+            import fcntl
+            fcntl.flock(self._f, fcntl.LOCK_UN)
+            self._f.close()
+            self._f = None
+            return False
+
+    def _read(self):
+        import json
+        try:
+            with open(self._path(), "r", encoding="utf-8") as f:
+                return json.loads(f.read())
+        except (OSError, ValueError):
+            return None
+
+    def _write(self) -> None:
+        import json
+        import os
+        tmp = self._path() + f".{os.getpid()}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(json.dumps({"owner": self.settings.owner_name,
+                                "deadline": time.time() + self._ttl()}))
+        os.replace(tmp, self._path())
+
+    def acquire(self, lease_lost_callback=None) -> bool:
+        with self._flocked(self._path()):
+            rec = self._read()
+            if rec is not None \
+                    and rec.get("owner") != self.settings.owner_name \
+                    and rec.get("deadline", 0) > time.time():
+                return False  # held by a live other owner
+            self._write()     # fresh, expired, or our own: (re)claim
+        self._start_heartbeat()
+        return True
+
+    def _start_heartbeat(self) -> None:
+        self._stop_heartbeat()
+
+        def beat():
+            with self._flocked(self._path()):
+                rec = self._read()
+                if rec is None or \
+                        rec.get("owner") != self.settings.owner_name:
+                    return  # lost; stop beating
+                try:
+                    self._write()
+                except OSError:
+                    return
+            self._start_heartbeat()
+
+        t = threading.Timer(self.settings.timeout.heartbeat_interval, beat)
+        t.daemon = True
+        t.start()
+        self._heartbeat_task = t
+
+    def _stop_heartbeat(self) -> None:
+        if self._heartbeat_task is not None:
+            self._heartbeat_task.cancel()
+            self._heartbeat_task = None
+
+    def release(self) -> bool:
+        import os
+        self._stop_heartbeat()
+        with self._flocked(self._path()):
+            rec = self._read()
+            if rec is not None and \
+                    rec.get("owner") == self.settings.owner_name:
+                try:
+                    os.unlink(self._path())
+                except OSError:
+                    pass
+        return True
+
+    def check_lease(self) -> bool:
+        rec = self._read()
+        return (rec is not None
+                and rec.get("owner") == self.settings.owner_name
+                and rec.get("deadline", 0) > time.time())
+
+
 _LEASE_IMPLS: Dict[str, Callable[[LeaseSettings], Lease]] = {
     "in-proc": InProcLease,
+    "file": FileLease,
 }
 
 

@@ -55,13 +55,19 @@ class _Cleanup:
 @dataclass(frozen=True)
 class ClusterSingletonSettings:
     """(reference: ClusterSingletonManagerSettings) — singleton name, role
-    filter, hand-over retry cadence."""
+    filter, hand-over retry cadence; `use_lease` guards instantiation with
+    a coordination lease (ClusterSingletonManagerSettings.LeaseSettings —
+    the singleton only starts while its node HOLDS the lease, so even a
+    split brain cannot run two instances)."""
     singleton_name: str = "singleton"
     role: Optional[str] = None
     hand_over_retry_interval: float = 0.25
     # proxy settings
     buffer_size: int = 1000
     singleton_identification_interval: float = 0.25
+    # lease guard (reference: singleton lease-implementation config)
+    use_lease: bool = False
+    lease_name: Optional[str] = None
 
 
 class ClusterSingletonManager(Actor):
@@ -131,6 +137,7 @@ class ClusterSingletonManager(Actor):
         self.cluster.unsubscribe(self._on_cluster_event)
         if self._retry_task:
             self._retry_task.cancel()
+        self._release_lease()
 
     def _on_cluster_event(self, event: Any) -> None:
         # runs on the cluster event thread; re-enter via our mailbox
@@ -210,7 +217,32 @@ class ClusterSingletonManager(Actor):
                 return m.unique_address
         return None
 
+    def _acquire_lease(self) -> bool:
+        """Take (or confirm) the singleton lease; False defers instantiation
+        to the next retry tick (the reference's AcquiringLease state)."""
+        if not self.settings.use_lease:
+            return True
+        if getattr(self, "_lease", None) is None:
+            from .lease import LeaseProvider
+            name = self.settings.lease_name or (
+                f"{self.context.system.name}-singleton-"
+                f"{self.settings.singleton_name}")
+            self._lease = LeaseProvider.get(self.context.system).get_lease(
+                name, "akka.cluster.singleton.lease",
+                str(self._self_node()))
+        return self._lease.acquire()
+
+    def _release_lease(self) -> None:
+        lease = getattr(self, "_lease", None)
+        if lease is not None:
+            lease.release()
+
     def _become_oldest(self) -> None:
+        if not self._acquire_lease():
+            # stay in BecomingOldest: the _Cleanup retry tick re-evaluates
+            # and re-attempts the acquire until the holder releases/expires
+            self.state = "BecomingOldest"
+            return
         self.state = "Oldest"
         if self.singleton is None:
             self.singleton = self.context.actor_of(
@@ -250,6 +282,9 @@ class ClusterSingletonManager(Actor):
                 ack.tell(HandOverDone(), self.self_ref)
                 self._pending_handover_ack = None
                 self.state = "End"
+                # the instance is gone: free the lease so the new oldest's
+                # acquire succeeds immediately
+                self._release_lease()
             return
         super().around_receive(receive, msg)
 

@@ -236,6 +236,7 @@ class TcpConnectionActor(Actor):
         self.is_outgoing = is_outgoing
         self.handler: Optional[ActorRef] = None
         self.keep_open = False
+        self._peer_closed = False  # peer EOF seen while keep_open
         self.out_buf: collections.deque = collections.deque()  # (bytes, ack, sender)
         self.closing: Optional[Any] = None
         self._registered = False
@@ -358,8 +359,18 @@ class TcpConnectionActor(Actor):
                     if isinstance(self.closing, ConfirmedClosed):
                         self._notify_closed(ConfirmedClosed())
                     elif self.keep_open:
-                        if self.handler:
-                            self.handler.tell(PeerClosed(), self.self_ref)
+                        # half-open: writes continue; read side is done —
+                        # drop READ interest (an EOF socket stays
+                        # read-ready, so leaving it armed busy-loops the
+                        # selector and spams PeerClosed) and remember the
+                        # EOF for the eventual ConfirmedClose handshake
+                        if not self._peer_closed:
+                            self._peer_closed = True
+                            if self.handler:
+                                self.handler.tell(PeerClosed(),
+                                                  self.self_ref)
+                        self._interest(read=False,
+                                       write=bool(self.out_buf))
                         return
                     else:
                         self._notify_closed(PeerClosed())
@@ -405,6 +416,12 @@ class TcpConnectionActor(Actor):
                 self.sock.shutdown(socket.SHUT_WR)
             except OSError:
                 pass
+            if self._peer_closed:
+                # the peer's EOF already arrived (keep_open half-open):
+                # both directions are now shut — finish immediately, the
+                # selector will never re-report the consumed EOF
+                self._notify_closed(ConfirmedClosed())
+                self.context.stop(self.self_ref)
             return  # wait for peer EOF
         self._notify_closed(self.closing)
         self.context.stop(self.self_ref)

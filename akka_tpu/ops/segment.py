@@ -186,22 +186,33 @@ def _deliver_sorted(dst, payload, valid, n_actors: int, need_max: bool) -> Deliv
 class SlotDelivery(NamedTuple):
     """Per-message mailbox delivery: each actor's first `slots` messages this
     step, in arrival order (per-sender FIFO), plus the EXACT commutative
-    aggregation over ALL addressed messages (not just the S kept) so
-    reduce-kind behaviors coexisting in a slots-mode system lose nothing."""
+    aggregation over all messages CONSUMED this step so reduce-kind behaviors
+    coexisting in a slots-mode system lose nothing. With a spill region
+    (spill_cap > 0), messages past the slot cap — and all mail addressed to
+    suspended rows — are NOT consumed: they come back compacted in the spill_*
+    outputs for redelivery next step (unbounded-mailbox semantics,
+    dispatch/Mailbox.scala:647 UnboundedMailbox; suspension retention,
+    actor/dungeon/FaultHandling.scala)."""
 
     types: jax.Array    # [N, S] int32 message-type tags (slot invalid -> 0)
     payload: jax.Array  # [N, S, P]
     valid: jax.Array    # [N, S] bool
-    count: jax.Array    # [N] int32 messages addressed (may exceed S)
-    sum: jax.Array      # [N, P] segment-sum over ALL messages (exact)
-    max: jax.Array      # [N, P] segment-max over ALL messages (zeros unless
+    count: jax.Array    # [N] int32 messages consumed this step
+    sum: jax.Array      # [N, P] segment-sum over consumed messages (exact)
+    max: jax.Array      # [N, P] segment-max over consumed (zeros unless
                         #        need_max)
-    dropped: jax.Array  # [] int32 total mailbox-overflow drops this step
+    dropped: jax.Array  # [] int32 REAL losses this step (spill overflow, or
+                        #    all overflow when spill_cap == 0)
+    spill_dst: jax.Array      # [spill_cap] int32 LOCAL rows (-1 = empty)
+    spill_type: jax.Array     # [spill_cap]
+    spill_payload: jax.Array  # [spill_cap, P]
+    spill_valid: jax.Array    # [spill_cap] bool
 
 
 def deliver_slots(dst: jax.Array, mtype: jax.Array, payload: jax.Array,
                   valid: jax.Array, n_actors: int, slots: int,
-                  need_max: bool = False) -> SlotDelivery:
+                  need_max: bool = False, spill_cap: int = 0,
+                  slots_kind=None, suspended=None) -> SlotDelivery:
     """Ordered per-message delivery into per-actor mailbox slots.
 
     The TPU-native form of the reference's discrete-envelope mailbox
@@ -214,13 +225,33 @@ def deliver_slots(dst: jax.Array, mtype: jax.Array, payload: jax.Array,
     parts: ordering under scatter delivery).
 
     dst: [M] int32; mtype: [M] int32; payload: [M, P]; valid: [M] bool.
-    Arrival order IS the index order of the inputs. Messages beyond `slots`
-    for one actor are dropped and counted (bounded-mailbox overflow,
-    dispatch/Mailbox.scala:415-443 — surface via dead letters host-side).
+    Arrival order IS the index order of the inputs.
+
+    spill_cap == 0 (bounded mailbox): messages beyond `slots` for one actor
+    are dropped and counted (dispatch/Mailbox.scala:415-443 — surface via
+    dead letters host-side); slots_kind/suspended are ignored.
+
+    spill_cap > 0 (unbounded semantics): overflow for slots-kind recipients
+    (slots_kind: [N] bool — reduce-kind recipients always consume everything
+    via the aggregation) and ALL mail to suspended rows (suspended: [N] bool)
+    is excluded from slots AND from the aggregation, and returned compacted
+    in (recipient, seq) order in the spill_* outputs; the caller writes it at
+    the FRONT of the next step's inbox, so redelivered mail sorts before any
+    fresh emission and per-sender FIFO is preserved across spill generations.
+    Only spill-region overflow is a real (counted) drop.
     """
     m, p = payload.shape
     ok = valid & (dst >= 0) & (dst < n_actors)
     key = jnp.where(ok, dst, n_actors).astype(jnp.int32)
+    cdst = jnp.clip(dst, 0, n_actors - 1)
+    if spill_cap > 0:
+        kind_m = (slots_kind[cdst] if slots_kind is not None
+                  else jnp.ones((m,), jnp.bool_))
+        susp_m = (suspended[cdst] if suspended is not None
+                  else jnp.zeros((m,), jnp.bool_))
+        flags = susp_m.astype(jnp.int32) * 2 + kind_m.astype(jnp.int32)
+    else:
+        flags = jnp.zeros((m,), jnp.int32)
 
     # ONE keyed sort carries every column: (recipient, arrival-index) as a
     # two-key sort IS the stable (recipient, seq) order, and payload/type
@@ -228,8 +259,8 @@ def deliver_slots(dst: jax.Array, mtype: jax.Array, payload: jax.Array,
     # x[order] is ~8x slower on TPU — gathers serialize, sorts vectorize)
     iota = jnp.arange(m, dtype=jnp.int32)
     fcols = tuple(payload[:, i] for i in range(p))
-    s = jax.lax.sort((key, iota, mtype) + fcols, num_keys=2)
-    skey, stype, sp = s[0], s[2], jnp.stack(s[3:], axis=1)
+    s = jax.lax.sort((key, iota, mtype, flags) + fcols, num_keys=2)
+    skey, stype, sflags, sp = s[0], s[2], s[3], jnp.stack(s[4:], axis=1)
 
     # rank within segment, gather-free: head flags on the sorted keys, then
     # a log-depth cummax of (head ? position : -1) gives each message its
@@ -239,7 +270,16 @@ def deliver_slots(dst: jax.Array, mtype: jax.Array, payload: jax.Array,
     start = jax.lax.cummax(jnp.where(head, iota, -1))
     rank = iota - start
     live = skey < n_actors
-    in_cap = live & (rank < slots)
+    if spill_cap > 0:
+        susp_s = sflags >= 2
+        kind_s = (sflags & 1).astype(jnp.bool_)
+        spill_m = live & (susp_s | (kind_s & (rank >= slots)))
+        in_cap = live & ~susp_s & (rank < slots)
+        consumed = live & ~spill_m
+    else:
+        spill_m = jnp.zeros((m,), jnp.bool_)
+        in_cap = live & (rank < slots)
+        consumed = live
     slot = jnp.where(in_cap, skey * slots + rank, n_actors * slots)
 
     buf_t = jnp.zeros((n_actors * slots + 1,), jnp.int32)
@@ -249,17 +289,35 @@ def deliver_slots(dst: jax.Array, mtype: jax.Array, payload: jax.Array,
     buf_p = buf_p.at[slot].set(jnp.where(in_cap[:, None], sp, 0))
     buf_v = buf_v.at[slot].set(in_cap)
 
-    dropped = jnp.sum((live & ~in_cap).astype(jnp.int32))
+    # spill compaction: cumsum positions preserve the (recipient, seq) sort
+    # order, so a spilled burst re-enters next step still in FIFO order
+    if spill_cap > 0:
+        pos = jnp.cumsum(spill_m.astype(jnp.int32)) - 1
+        placed = spill_m & (pos < spill_cap)
+        sslot = jnp.where(placed, pos, spill_cap)
+        sp_dst = jnp.full((spill_cap + 1,), -1, jnp.int32
+                          ).at[sslot].set(jnp.where(placed, skey, -1))
+        sp_type = jnp.zeros((spill_cap + 1,), jnp.int32
+                            ).at[sslot].set(jnp.where(placed, stype, 0))
+        sp_pl = jnp.zeros((spill_cap + 1, p), payload.dtype
+                          ).at[sslot].set(jnp.where(placed[:, None], sp, 0))
+        sp_v = jnp.zeros((spill_cap + 1,), jnp.bool_).at[sslot].set(placed)
+        dropped = jnp.sum((spill_m & ~placed).astype(jnp.int32))
+        spill_out = (sp_dst[:-1], sp_type[:-1], sp_pl[:-1], sp_v[:-1])
+    else:
+        dropped = jnp.sum((live & ~in_cap).astype(jnp.int32))
+        spill_out = (jnp.full((0,), -1, jnp.int32), jnp.zeros((0,), jnp.int32),
+                     jnp.zeros((0, p), payload.dtype), jnp.zeros((0,), jnp.bool_))
 
-    # exact full-inbox aggregation alongside the slots, via the same
+    # exact consumed-message aggregation alongside the slots, via the same
     # merged-marker compaction as _deliver_merge (gather-free): markers
     # sort after their segment, cumsums are read back actor-ordered
     key2 = jnp.concatenate([skey * 2,
                             jnp.arange(n_actors + 1, dtype=jnp.int32) * 2 + 1])
     zc = jnp.zeros((n_actors + 1,), payload.dtype)
-    sp_masked = jnp.where(live[:, None], sp, 0)
+    sp_masked = jnp.where(consumed[:, None], sp, 0)
     mcols = tuple(jnp.concatenate([sp_masked[:, i], zc]) for i in range(p))
-    mcnt = jnp.concatenate([live.astype(jnp.int32),
+    mcnt = jnp.concatenate([consumed.astype(jnp.int32),
                             jnp.zeros((n_actors + 1,), jnp.int32)])
     s1 = jax.lax.sort((key2,) + mcols + (mcnt,), num_keys=1)
     csums = tuple(jnp.cumsum(c) for c in s1[1:-1])
@@ -289,6 +347,10 @@ def deliver_slots(dst: jax.Array, mtype: jax.Array, payload: jax.Array,
         sum=sums,
         max=maxs,
         dropped=dropped,
+        spill_dst=spill_out[0],
+        spill_type=spill_out[1],
+        spill_payload=spill_out[2],
+        spill_valid=spill_out[3],
     )
 
 

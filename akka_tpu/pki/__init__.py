@@ -1,0 +1,12 @@
+"""PKI: PEM decoding + DER private-key classification (akka-pki parity,
+akka-pki/src/main/scala/akka/pki/pem/)."""
+
+from .pem import (DERPrivateKeyLoader, PEMData, PEMLoadingException,
+                  PrivateKeyInfo, decode, decode_all, load_certificates,
+                  load_private_key)
+
+__all__ = [
+    "DERPrivateKeyLoader", "PEMData", "PEMLoadingException",
+    "PrivateKeyInfo", "decode", "decode_all", "load_certificates",
+    "load_private_key",
+]

@@ -150,16 +150,27 @@ class RemoteSystemDaemon(Actor):
             for m, snd, _sys in self._pending.pop(message[1], ()):
                 self.context.system.event_stream.publish(
                     DeadLetter(m, snd, self.self_ref))
+        elif isinstance(message, tuple) and message and message[0] == "drop-failed":
+            # failure records only need to live long enough to dead-letter
+            # in-flight sends; on a long-lived node they must not accumulate
+            self._failed.pop(message[1], None)
         elif isinstance(message, tuple) and message and message[0] == "origin-parent-died":
             for name in self._parent_children.pop(message[1], ()):
                 child = self.context.child(name)
                 if child is not None:
                     self.context.stop(child)
         elif isinstance(message, Terminated):
-            # one of OUR children stopped: drop life-cycle bookkeeping
+            # one of OUR children stopped: drop life-cycle bookkeeping, and
+            # once an origin parent has no deployed children left, unwatch it
+            # and drop its (now empty) entry
             name = message.actor.path.name
-            for kids in self._parent_children.values():
+            for parent, kids in list(self._parent_children.items()):
                 kids.discard(name)
+                if not kids:
+                    del self._parent_children[parent]
+                    parent_ref = self.provider.resolve_actor_ref(parent)
+                    if parent_ref is not self.provider.dead_letters:
+                        self.context.unwatch(parent_ref)
         else:
             return NotImplemented
         return None
@@ -171,6 +182,12 @@ class RemoteSystemDaemon(Actor):
         if isinstance(message, _RemoteTerminate):
             child.stop()
         elif system and isinstance(message, _sysmsg.SystemMessage):
+            if isinstance(message, (_sysmsg.Watch, _sysmsg.Unwatch)):
+                # a Watch that raced the deploy deserialized its watchee ref
+                # BEFORE the child existed → dead letters; by protocol the
+                # watchee of a Watch delivered to child X is X, so re-point
+                import dataclasses
+                message = dataclasses.replace(message, watchee=child)
             child.send_system_message(message)
         else:
             child.tell(message, sender)
@@ -235,6 +252,9 @@ class RemoteSystemDaemon(Actor):
                          origin=msg.origin_path)
         except Exception as e:  # noqa: BLE001 — report, don't kill the daemon
             self._failed[msg.child_name] = repr(e)
+            me, name = self.self_ref, msg.child_name
+            self.context.system.scheduler.schedule_once(
+                5.0, lambda: me.tell(("drop-failed", name)))
             for m, snd, _sys in self._pending.pop(msg.child_name, ()):
                 self.context.system.event_stream.publish(
                     DeadLetter(m, snd, self.self_ref))

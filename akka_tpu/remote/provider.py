@@ -199,7 +199,15 @@ class RemoteActorRefProvider(LocalActorRefProvider):
         host = cfg.get_string("akka.remote.canonical.hostname", "127.0.0.1")
         port = cfg.get_int("akka.remote.canonical.port", 0)
         kind = cfg.get_string("akka.remote.transport", "tcp")
-        self.transport = (InProcTransport() if kind == "inproc" else TcpTransport())
+        if kind == "inproc":
+            self.transport = InProcTransport()
+        elif kind == "tls-tcp":
+            # TLS on the wire (SSLEngineProvider.scala:66 seam): PEM paths
+            # from akka.remote.tls.*, mutual auth on by default
+            from .transport import TlsSettings, TlsTcpTransport
+            self.transport = TlsTcpTransport(TlsSettings.from_config(cfg))
+        else:
+            self.transport = TcpTransport()
         bound_host, bound_port = self.transport.listen(host, port, self._inbound)
         self.local_address = Address("akka", self.system_name, bound_host, bound_port)
         self.transport.local_address = f"{bound_host}:{bound_port}"

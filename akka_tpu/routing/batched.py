@@ -43,3 +43,47 @@ def consistent_hash_dst(keys: jax.Array, routee_base: int, n_routees: int) -> ja
 def broadcast_dst(n_routees: int, routee_base: int) -> jax.Array:
     """All routees (use with out_degree = n_routees emissions)."""
     return routee_base + jnp.arange(n_routees, dtype=jnp.int32)
+
+
+class BatchedRouter:
+    """Router-as-index-map: the device-tier `Router.route` seam
+    (routing/Router.scala:116 — fan-out WITHOUT going through a router
+    mailbox, here without leaving the vmapped step at all).
+
+    `route(key, step)` is scalar JAX, so behaviors call it under vmap to
+    compute one message's routee row; the logic string mirrors the pool
+    types of the reference (RoundRobinPool / RandomPool /
+    ConsistentHashingPool, routing/RoundRobinRoutingLogic et al.).
+    RoundRobin keys on (sender, step) so each producer's successive
+    messages walk successive routees, exactly the classic pool contract
+    per sender.
+    """
+
+    LOGICS = ("round-robin", "random", "consistent-hash")
+
+    def __init__(self, logic: str, routee_base: int, n_routees: int):
+        if logic not in self.LOGICS:
+            raise ValueError(f"unknown routing logic {logic!r}; "
+                             f"one of {self.LOGICS}")
+        if n_routees <= 0:
+            raise ValueError("n_routees must be > 0")
+        self.logic = logic
+        self.routee_base = routee_base
+        self.n_routees = n_routees
+
+    def route(self, key, step=0) -> jax.Array:
+        """Routee row for one message. `key` identifies the sender (or the
+        hash key for consistent-hash); `step` advances round-robin state."""
+        key = jnp.asarray(key, jnp.int32)
+        step = jnp.asarray(step, jnp.int32)
+        if self.logic == "round-robin":
+            idx = (key + step) % self.n_routees
+        elif self.logic == "random":
+            # Knuth multiplicative constant exceeds int32: mix in uint32
+            mixed = (key.astype(jnp.uint32) * jnp.uint32(2654435761)
+                     + step.astype(jnp.uint32))
+            idx = (_fnv1a(mixed.astype(jnp.int32))
+                   % jnp.uint32(self.n_routees)).astype(jnp.int32)
+        else:  # consistent-hash: stable in `key`, step-independent
+            idx = (_fnv1a(key) % jnp.uint32(self.n_routees)).astype(jnp.int32)
+        return self.routee_base + idx

@@ -57,6 +57,12 @@ class DeviceEntity:
     mailbox_slots: int = 0
     host_inbox_per_shard: int = 256
     extra_behaviors: Sequence[BatchedBehavior] = field(default_factory=tuple)
+    # optional coordination lease (cluster_tools.lease.Lease): when set,
+    # rebalance() must ACQUIRE it first — the reference guards shard
+    # hand-off with a lease so two coordinators can't move shards
+    # concurrently (SplitBrainResolver.scala:45-55 lease plumbing /
+    # ShardCoordinator lease usage)
+    lease: Optional[Any] = None
 
 
 class DeviceEntityRef:
@@ -219,6 +225,11 @@ class DeviceShardRegion:
         messages addressed into the old block are re-pointed).
 
         Returns the new physical block index."""
+        lease = self.spec.lease
+        if lease is not None and not lease.acquire():
+            raise RuntimeError(
+                f"rebalance of shard {shard} denied: coordination lease "
+                f"{lease.settings.lease_name!r} is held elsewhere")
         with self._lock:
             old_block = int(self._shard_block[shard])
             candidates = self._free_blocks

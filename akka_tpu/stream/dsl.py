@@ -15,6 +15,7 @@ Materialized values compose with Keep.left/right/both/none.
 
 from __future__ import annotations
 
+import collections
 import itertools
 import threading
 from concurrent.futures import Future
@@ -26,6 +27,24 @@ from .stage import (FlowShape, GraphStage, GraphStageLogic, Inlet, Outlet,
                     SinkShape, SourceShape, make_in_handler, make_out_handler)
 from . import ops as _ops
 from . import ops2 as _ops2
+from . import ops3 as _ops3
+
+
+def _map_future(fut: Future, fn) -> Future:
+    """Future[A] -> Future[fn(A)] (mat-value adaption for composed sinks)."""
+    out: Future = Future()
+
+    def done(f):
+        ex = f.exception()
+        if ex is not None:
+            out.set_exception(ex)
+        else:
+            try:
+                out.set_result(fn(f.result()))
+            except Exception as e:  # noqa: BLE001
+                out.set_exception(e)
+    fut.add_done_callback(done)
+    return out
 
 
 class Keep:
@@ -36,17 +55,24 @@ class Keep:
 
 
 class _Builder:
-    """Collects stage logics + edges during one materialization."""
+    """Collects stage logics + edges during one materialization. Stages are
+    tagged with the CURRENT ISLAND; `next_island()` (the `.async_()`
+    boundary) starts a new one — edges that end up crossing islands become
+    backpressured actor-to-actor channels (the reference's island tracking
+    in PhasedFusingActorMaterializer.scala:391 islandTracking)."""
 
     def __init__(self, materializer: "Materializer"):
         self.materializer = materializer
         self.logics: List[GraphStageLogic] = []
         self.logic_by_port: Dict[int, GraphStageLogic] = {}
         self.edges: List[Tuple[Outlet, Inlet]] = []
+        self.current_island = 0
+        self.island_of: Dict[int, int] = {}  # id(logic) -> island
 
     def add(self, stage: GraphStage) -> Tuple[GraphStageLogic, Any]:
         logic, mat = stage.create_logic_and_mat()
         self.logics.append(logic)
+        self.island_of[id(logic)] = self.current_island
         for p in logic.shape.inlets:
             self.logic_by_port[p.id] = logic
         for p in logic.shape.outlets:
@@ -56,9 +82,161 @@ class _Builder:
     def connect(self, outlet: Outlet, inlet: Inlet) -> None:
         self.edges.append((outlet, inlet))
 
+    def next_island(self) -> None:
+        self.current_island += 1
+
+
+_CHANNEL_BATCH = 16
+
+
+class _IslandChannel:
+    """Backpressured element channel across an async boundary: both ends
+    talk ONLY through the target interpreter's async-callback mailbox (the
+    reference's BatchingActorInputBoundary / ActorOutputBoundary pair in
+    impl/fusing/ActorGraphInterpreter.scala). Demand flows upstream in
+    batches; elements, completion, and failure flow downstream."""
+
+    def __init__(self):
+        self.sink = None    # _ChannelSink (upstream island)
+        self.source = None  # _ChannelSource (downstream island)
+        # events sent before the peer island's actor started are held and
+        # flushed from its pre_start (islands spawn in arbitrary order)
+        self._lock = threading.Lock()
+        self._sink_ready = False
+        self._source_ready = False
+        self._pend_sink: List[Any] = []
+        self._pend_source: List[Any] = []
+
+    def to_source(self, ev) -> None:
+        with self._lock:
+            if not self._source_ready:
+                self._pend_source.append(ev)
+                return
+        self.source._cb.invoke(ev)
+
+    def to_sink(self, ev) -> None:
+        with self._lock:
+            if not self._sink_ready:
+                self._pend_sink.append(ev)
+                return
+        self.sink._cb.invoke(ev)
+
+    def source_started(self) -> None:
+        with self._lock:
+            self._source_ready = True
+            pending, self._pend_source = self._pend_source, []
+        for ev in pending:
+            self.source._cb.invoke(ev)
+
+    def sink_started(self) -> None:
+        with self._lock:
+            self._sink_ready = True
+            pending, self._pend_sink = self._pend_sink, []
+        for ev in pending:
+            self.sink._cb.invoke(ev)
+
+
+class _ChannelSink(GraphStageLogic):
+    """Upstream-island end of an async boundary (output boundary)."""
+
+    def __init__(self, channel: _IslandChannel):
+        in_ = Inlet("Island.in")
+        super().__init__(SinkShape(in_))
+        self.in_ = in_
+        self.channel = channel
+        self.demand = 0
+        channel.sink = self
+        self._cb = self.get_async_callback(self._on_event)
+
+        def on_push():
+            self.demand -= 1
+            channel.to_source(("elem", self.grab(in_)))
+            if self.demand > 0:
+                self.pull(in_)
+
+        def on_finish():
+            channel.to_source(("complete", None))
+
+        def on_fail(ex):
+            channel.to_source(("fail", ex))
+
+        self.set_handler(in_, make_in_handler(on_push, on_finish, on_fail))
+
+    def pre_start(self):
+        self.channel.sink_started()
+
+    def _on_event(self, ev):
+        kind, arg = ev
+        if kind == "demand":
+            self.demand += arg
+            if self.demand > 0 and not self.has_been_pulled(self.in_) \
+                    and not self.is_closed(self.in_):
+                self.pull(self.in_)
+        elif kind == "cancel":
+            self.cancel(self.in_)
+
+
+class _ChannelSource(GraphStageLogic):
+    """Downstream-island end of an async boundary (input boundary):
+    buffers up to a batch of elements and keeps demand outstanding."""
+
+    def __init__(self, channel: _IslandChannel):
+        out = Outlet("Island.out")
+        super().__init__(SourceShape(out))
+        self.out = out
+        self.channel = channel
+        self.buf = collections.deque()
+        self.outstanding = 0
+        self.done = False
+        self.failure: Optional[BaseException] = None
+        channel.source = self
+        self._cb = self.get_async_callback(self._on_event)
+
+        def on_cancel(cause=None):
+            channel.to_sink(("cancel", None))
+
+        self.set_handler(out, make_out_handler(self._pump, on_cancel))
+
+    def pre_start(self):
+        self.channel.source_started()
+        self.outstanding = _CHANNEL_BATCH
+        self.channel.to_sink(("demand", _CHANNEL_BATCH))
+
+    def _pump(self):
+        if self.failure is not None:
+            self.fail(self.out, self.failure)
+            return
+        if self.buf and self.is_available(self.out):
+            self.push(self.out, self.buf.popleft())
+        if self.done and not self.buf:
+            self.complete(self.out)
+            return
+        want = _CHANNEL_BATCH - len(self.buf) - self.outstanding
+        if want >= _CHANNEL_BATCH // 2 and not self.done:
+            self.outstanding += want
+            self.channel.to_sink(("demand", want))
+
+    def _on_event(self, ev):
+        kind, arg = ev
+        if kind == "elem":
+            self.outstanding -= 1
+            self.buf.append(arg)
+        elif kind == "complete":
+            self.done = True
+        elif kind == "fail":
+            self.failure = arg
+        self._pump()
+
 
 class Materializer:
-    """(reference: stream/Materializer.scala / SystemMaterializer.scala)"""
+    """(reference: stream/Materializer.scala / SystemMaterializer.scala).
+
+    Materialization walks the blueprint once, groups stages into fused
+    ISLANDS split at `.async_()` boundaries, and spawns ONE
+    ActorGraphInterpreter per island — cross-island edges run through
+    backpressured async channels (PhasedFusingActorMaterializer.scala:391
+    materialize + island assignment; a single-island graph stays one
+    actor, the reference's default maximal fusion)."""
 
     _counter = itertools.count()
 
@@ -68,15 +246,57 @@ class Materializer:
     def materialize(self, build: Callable[[_Builder], Any]) -> Any:
         b = _Builder(self)
         mat = build(b)
-        connections = []
-        for i, (outlet, inlet) in enumerate(b.edges):
-            connections.append(Connection(
-                i, b.logic_by_port[outlet.id], outlet,
-                b.logic_by_port[inlet.id], inlet))
-        interp = GraphInterpreter(b.logics, connections, materializer=self)
-        self.system.actor_of(
-            Props.create(ActorGraphInterpreter, interp),
-            f"stream-{next(Materializer._counter)}")
+        islands = sorted({b.island_of[id(lg)] for lg in b.logics})
+        run_id = next(Materializer._counter)
+        if len(islands) <= 1:
+            connections = []
+            for i, (outlet, inlet) in enumerate(b.edges):
+                connections.append(Connection(
+                    i, b.logic_by_port[outlet.id], outlet,
+                    b.logic_by_port[inlet.id], inlet))
+            interp = GraphInterpreter(b.logics, connections,
+                                      materializer=self)
+            self.system.actor_of(
+                Props.create(ActorGraphInterpreter, interp),
+                f"stream-{run_id}")
+            return mat
+
+        # multi-island: split edges at boundaries
+        by_island: Dict[int, List[GraphStageLogic]] = {
+            isl: [] for isl in islands}
+        for lg in b.logics:
+            by_island[b.island_of[id(lg)]].append(lg)
+        island_edges: Dict[int, List[Tuple[Outlet, Inlet]]] = {
+            isl: [] for isl in islands}
+        for outlet, inlet in b.edges:
+            out_isl = b.island_of[id(b.logic_by_port[outlet.id])]
+            in_isl = b.island_of[id(b.logic_by_port[inlet.id])]
+            if out_isl == in_isl:
+                island_edges[out_isl].append((outlet, inlet))
+            else:
+                ch = _IslandChannel()
+                snk = _ChannelSink(ch)
+                src = _ChannelSource(ch)
+                by_island[out_isl].append(snk)
+                by_island[in_isl].append(src)
+                island_edges[out_isl].append((outlet, snk.in_))
+                island_edges[in_isl].append((src.out, inlet))
+
+        for isl in islands:
+            port_owner: Dict[int, GraphStageLogic] = {}
+            for lg in by_island[isl]:
+                for p in lg.shape.inlets:
+                    port_owner[p.id] = lg
+                for p in lg.shape.outlets:
+                    port_owner[p.id] = lg
+            connections = [
+                Connection(i, port_owner[o.id], o, port_owner[i_.id], i_)
+                for i, (o, i_) in enumerate(island_edges[isl])]
+            interp = GraphInterpreter(by_island[isl], connections,
+                                      materializer=self)
+            self.system.actor_of(
+                Props.create(ActorGraphInterpreter, interp),
+                f"stream-{run_id}-island-{isl}")
         return mat
 
 
@@ -141,6 +361,50 @@ class Source:
     @staticmethod
     def from_future(fut: Future) -> "Source":
         return Source.from_graph(lambda: _ops.FutureSource(fut))
+
+    @staticmethod
+    def never() -> "Source":
+        """Emits nothing and never completes (scaladsl Source.never)."""
+        return Source.from_graph(lambda: _ops3.NeverSource())
+
+    @staticmethod
+    def lazy_source(factory: Callable[[], "Source"]) -> "Source":
+        """Defer building the inner Source until the stream is pulled
+        (scaladsl Source.lazySource)."""
+        return Source.single(None).flat_map_concat(lambda _: factory())
+
+    @staticmethod
+    def lazy_single(thunk: Callable[[], Any]) -> "Source":
+        """Defer computing the single element until pulled
+        (scaladsl Source.lazySingle)."""
+        return Source.single(None).map(lambda _: thunk())
+
+    @staticmethod
+    def lazy_future(thunk: Callable[[], Future]) -> "Source":
+        """Defer creating the Future until pulled (Source.lazyFuture)."""
+        return Source.lazy_source(lambda: Source.from_future(thunk()))
+
+    @staticmethod
+    def unfold_resource(create: Callable[[], Any],
+                        read: Callable[[Any], Optional[Any]],
+                        close: Callable[[Any], None]) -> "Source":
+        """Open a resource per materialization, emit read() values until it
+        returns None, close on completion/failure (Source.unfoldResource)."""
+        def gen():
+            resource = create()
+            try:
+                while True:
+                    v = read(resource)
+                    if v is None:
+                        return
+                    yield v
+            finally:
+                close(resource)
+
+        class _PerRun:
+            def __iter__(self):
+                return gen()
+        return Source.from_graph(lambda: _ops.IterableSource(_PerRun()))
 
     @staticmethod
     def actor_ref(buffer_size: int = 256) -> "Source":
@@ -456,28 +720,100 @@ class Flow:
     def flat_map_concat(self, fn: Callable[[Any], "Source"]) -> "Flow":
         return self._append(lambda: _ops.FlatMapConcat(fn))
 
-    def merge(self, other: Source) -> "Flow":
+    def _fan_in(self, other: Source, stage_factory,
+                self_first: bool = True) -> "Flow":
+        """Join this flow's output with another Source through a 2-in
+        stage (the scaladsl pattern of merge/zip/concat/orElse/... taking
+        a Graph[SourceShape] argument)."""
         prev, other_build = self._build, other._build
 
         def build(b: _Builder, upstream: Outlet):
             o1, m1 = prev(b, upstream)
             o2, _ = other_build(b)
-            logic, _l = b.add(_ops.MergeStage(2))
-            b.connect(o1, logic.shape.ins[0])
-            b.connect(o2, logic.shape.ins[1])
+            logic, _l = b.add(stage_factory())
+            first, second = (o1, o2) if self_first else (o2, o1)
+            b.connect(first, logic.shape.ins[0])
+            b.connect(second, logic.shape.ins[1])
             return logic.shape.out, m1
         return Flow(build)
 
+    def merge(self, other: Source) -> "Flow":
+        return self._fan_in(other, lambda: _ops.MergeStage(2))
+
     def zip(self, other: Source) -> "Flow":
-        prev, other_build = self._build, other._build
+        return self._fan_in(
+            other, lambda: _ops.ZipWithStage(lambda a, bb: (a, bb)))
+
+    def zip_with(self, other: Source, fn) -> "Flow":
+        return self._fan_in(other, lambda: _ops.ZipWithStage(fn))
+
+    def zip_latest(self, other: Source) -> "Flow":
+        return self.zip_latest_with(other, lambda a, b: (a, b))
+
+    def zip_latest_with(self, other: Source, fn) -> "Flow":
+        return self._fan_in(other, lambda: _ops3.ZipLatestStage(fn))
+
+    def zip_all(self, other: Source, this_default, that_default) -> "Flow":
+        return self._fan_in(other, lambda: _ops3.ZipAllStage(
+            this_default, that_default))
+
+    def concat(self, other: Source) -> "Flow":
+        return self._fan_in(other, lambda: _ops.ConcatStage(2))
+
+    def prepend(self, other: Source) -> "Flow":
+        return self._fan_in(other, lambda: _ops.ConcatStage(2),
+                            self_first=False)
+
+    def or_else(self, other: Source) -> "Flow":
+        return self._fan_in(other, lambda: _ops.OrElseStage())
+
+    def interleave(self, other: Source, segment_size: int) -> "Flow":
+        return self._fan_in(other, lambda: _ops.InterleaveStage(segment_size))
+
+    def merge_sorted(self, other: Source, key=None) -> "Flow":
+        return self._fan_in(other, lambda: _ops3.MergeSortedStage(key))
+
+    def merge_prioritized(self, other: Source, this_prio: int,
+                          that_prio: int) -> "Flow":
+        return self._fan_in(other, lambda: _ops3.MergePrioritizedStage(
+            [this_prio, that_prio]))
+
+    def divert_to(self, sink: "Sink", when) -> "Flow":
+        """Route elements matching `when` into `sink`, pass the rest on
+        (scaladsl/Flow.scala divertTo)."""
+        prev, sink_build = self._build, sink._build
 
         def build(b: _Builder, upstream: Outlet):
             o1, m1 = prev(b, upstream)
-            o2, _ = other_build(b)
-            logic, _l = b.add(_ops.ZipWithStage(lambda a, bb: (a, bb)))
-            b.connect(o1, logic.shape.ins[0])
-            b.connect(o2, logic.shape.ins[1])
-            return logic.shape.out, m1
+            logic, _ = b.add(_ops3.DivertToStage(when))
+            b.connect(o1, logic.shape.in_)
+            sink_build(b, logic.shape.outs[1])
+            return logic.shape.outs[0], m1
+        return Flow(build)
+
+    def fold_async(self, zero, fn) -> "Flow":
+        """fn(acc, elem) -> Future (or plain value); emits the final
+        aggregate at completion (scaladsl foldAsync)."""
+        return self._append(lambda: _ops3.FoldAsync(zero, fn))
+
+    def scan_async(self, zero, fn) -> "Flow":
+        return self._append(lambda: _ops3.FoldAsync(zero, fn,
+                                                    emit_each=True))
+
+    def on_error_complete(self, pred=None) -> "Flow":
+        return self._append(lambda: _ops3.OnErrorComplete(pred))
+
+    def async_(self) -> "Flow":
+        """Mark an ASYNC BOUNDARY: stages after this point run in their own
+        island (one interpreter actor per island), with backpressure across
+        the boundary (scaladsl .async; PhasedFusingActorMaterializer
+        island assignment)."""
+        prev = self._build
+
+        def build(b: _Builder, upstream: Outlet):
+            o, m = prev(b, upstream)
+            b.next_island()
+            return o, m
         return Flow(build)
 
     # -- sub-streams (impl/fusing/StreamOfStreams.scala) ---------------------
@@ -625,6 +961,53 @@ class Sink:
         return Sink.from_graph(lambda: _ops.ActorRefSink(
             ref, on_complete_message, on_failure_message))
 
+    @staticmethod
+    def count() -> "Sink":
+        return Sink.fold(0, lambda acc, _elem: acc + 1)
+
+    @staticmethod
+    def take_last(n: int) -> "Sink":
+        """Future completing with the last n elements (Sink.takeLast)."""
+        import collections as _c
+
+        def build(b: _Builder, upstream: Outlet):
+            logic, mat = b.add(_ops.FoldSink(
+                _c.deque(maxlen=n),
+                lambda acc, e: (acc.append(e), acc)[1]))
+            b.connect(upstream, logic.shape.inlets[0])
+            return _map_future(mat, list)
+        return Sink(build)
+
+    @staticmethod
+    def exists(pred) -> "Sink":
+        """Future[bool]: does any element satisfy pred? Cancels upstream at
+        the first match (Sink.exists)."""
+        inner = Flow().filter(pred).take(1) \
+            .to(Sink.head_option(), Keep.right)
+
+        def build(b: _Builder, upstream: Outlet):
+            fut = inner._build(b, upstream)
+            return _map_future(fut, lambda v: v is not None)
+        return Sink(build)
+
+    @staticmethod
+    def forall(pred) -> "Sink":
+        """Future[bool]: do ALL elements satisfy pred? (Sink.forall)"""
+        neg = Sink.exists(lambda x: not pred(x))
+
+        def build(b: _Builder, upstream: Outlet):
+            return _map_future(neg._build(b, upstream), lambda v: not v)
+        return Sink(build)
+
+    @staticmethod
+    def never() -> "Sink":
+        """Consumes nothing — never signals demand (Sink.never)."""
+        def build(b: _Builder, upstream: Outlet):
+            logic, mat = b.add(_ops3.NeverSink())
+            b.connect(upstream, logic.shape.inlets[0])
+            return mat
+        return Sink(build)
+
     def contramap(self, fn) -> "Sink":
         return Flow().map(fn).to(self, Keep.right)
 
@@ -656,6 +1039,9 @@ _SOURCE_MIRRORED_OPS = [
     "limit_weighted", "initial_timeout", "completion_timeout",
     "idle_timeout", "keep_alive", "map_error", "deduplicate",
     "recover_with_retries", "watch_termination",
+    "zip_latest", "zip_latest_with", "zip_all", "merge_sorted",
+    "merge_prioritized", "divert_to", "fold_async", "scan_async",
+    "on_error_complete", "async_",
 ]
 
 

@@ -139,7 +139,11 @@ class AsyncCallback:
         self._handler = handler
 
     def invoke(self, event: Any = None) -> None:
-        self._interpreter.enqueue_async(self._logic, self._handler, event)
+        # resolve lazily: a callback created inside create_logic (before the
+        # logic is wired into an interpreter) must still work at runtime
+        interp = self._interpreter if self._interpreter is not None \
+            else self._logic.interpreter
+        interp.enqueue_async(self._logic, self._handler, event)
 
 
 class GraphStageLogic:

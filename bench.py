@@ -9,18 +9,94 @@ tracked alongside, configs:
   3. 1M -> 1k fan-in aggregator
   4. RoundRobinPool 100k routees         -> dynamic delivery (shifting map)
   5. 256 shards x 4k entities cross-shard tells on the device mesh
+plus a delivery-mode comparison (merge vs sort vs scatter; slots vs reduce)
+so kernel-choice claims live in the bench artifact, not docstrings.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 Detail goes to stderr. --smoke runs tiny configs for CI; --config X runs one.
+
+Robustness contract (the driver runs this unattended on a tunneled TPU):
+this script ALWAYS prints a JSON line and exits 0. Backend init is probed
+in a subprocess with a hard timeout first — a wedged TPU tunnel hangs
+rather than raising, so in-process retry alone cannot recover — and falls
+back to CPU (recorded in extra["platform"]) rather than dying.
 """
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
 
 BASELINE_MSGS_PER_SEC = 10_000_000  # implied ForkJoinDispatcher JMH reference
+
+HEADLINE_METRIC = "actor.tell() throughput, 1M-actor ring (uniform 1-msg mailbox)"
+
+
+def _probe_default_backend(timeout_s: float) -> tuple[bool, str]:
+    """Try `jax.devices()` in a THROWAWAY subprocess with a hard timeout.
+
+    The in-process call can hang forever on a wedged tunnel (observed: >120s
+    with no exception), and once it fails in-process jax caches the broken
+    backend state. Probing out-of-process keeps this process clean either way.
+    """
+    code = "import jax; d = jax.devices(); print(d[0].platform)"
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=timeout_s,
+                           capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {timeout_s:.0f}s"
+    if r.returncode != 0:
+        return False, (r.stderr.strip().splitlines() or ["unknown"])[-1][:300]
+    return True, r.stdout.strip()
+
+
+def _init_backend(probe_timeout: float, attempts: int):
+    """Initialize the jax backend defensively; return (device, info dict).
+
+    Order: honor an explicit JAX_PLATFORMS=cpu request (via live config —
+    an ambient sitecustomize platform otherwise wins over the env var, the
+    exact hang VERDICT r2 reproduced); else probe the default backend in a
+    subprocess with retries+backoff; on failure fall back to CPU. Returns
+    (None, info) only if even the CPU backend fails.
+    """
+    info = {}
+    import jax
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        jax.config.update("jax_platforms", "cpu")
+        info["platform"] = "cpu (JAX_PLATFORMS)"
+    else:
+        ok, detail = False, ""
+        for i in range(attempts):
+            ok, detail = _probe_default_backend(probe_timeout)
+            if ok:
+                break
+            print(f"[bench] backend probe {i + 1}/{attempts} failed: {detail}",
+                  file=sys.stderr)
+            if i + 1 < attempts:
+                time.sleep(10.0 * (i + 1))
+        if ok:
+            info["platform"] = detail
+        else:
+            info["platform"] = "cpu (fallback)"
+            info["backend_error"] = detail
+            jax.config.update("jax_platforms", "cpu")
+    try:
+        return jax.devices()[0], info
+    except Exception as e:  # noqa: BLE001
+        if info.get("platform") != "cpu (fallback)":
+            # probe said OK but in-process init still died; last resort: CPU
+            try:
+                jax.config.update("jax_platforms", "cpu")
+                info["backend_error"] = repr(e)[:300]
+                info["platform"] = "cpu (fallback)"
+                return jax.devices()[0], info
+            except Exception as e2:  # noqa: BLE001
+                e = e2
+        info["backend_error"] = repr(e)[:300]
+        return None, info
 
 
 def _throughput(sys_, steps: int, msgs_per_step: int, warmup: int):
@@ -56,6 +132,19 @@ def bench_fan_in(n_leaves, steps):
 def bench_router(n_producers, n_routees, steps):
     from akka_tpu.models.baseline_benches import build_router
     s = build_router(n_producers=n_producers, n_routees=n_routees)
+    rate, dt = _throughput(s, steps, n_producers, warmup=2)
+    hits = s.read_state("hits")[:n_routees]
+    ok = bool(hits.sum() == (steps + 2 - 1) * n_producers)
+    return rate, dt, ok
+
+
+def bench_router_api(n_producers, n_routees, steps):
+    """Config 4 through the PUBLIC routing seam (routing/batched.py): the
+    producers emit through a RoundRobin BatchedRouter index map rather than
+    a hand-rolled (id + step) % n expression, so the number prices the
+    abstraction users touch (routing/Router.scala:116 analogue)."""
+    from akka_tpu.models.baseline_benches import build_router_api
+    s = build_router_api(n_producers=n_producers, n_routees=n_routees)
     rate, dt = _throughput(s, steps, n_producers, warmup=2)
     hits = s.read_state("hits")[:n_routees]
     ok = bool(hits.sum() == (steps + 2 - 1) * n_producers)
@@ -145,18 +234,64 @@ def bench_latency(rounds):
             "rounds": rounds}
 
 
+def bench_modes(n, steps):
+    """Delivery-kernel comparison on the dynamic ring, published in the
+    artifact so kernel claims are checkable (VERDICT r2 weak #3): the three
+    dynamic delivery modes (ops/segment.py deliver: merge-marker reduction /
+    sort-segment / scatter-add) and the slots-mode ordered mailbox
+    (deliver_slots) against the reduce default."""
+    import jax.numpy as jnp
+    from akka_tpu.batched import BatchedSystem, Emit, behavior
+    from akka_tpu.models.baseline_benches import (PAYLOAD_W, ring_behavior,
+                                                  seed_ring_full)
+
+    out = {}
+
+    def time_sys(s):
+        seed_ring_full(s)
+        rate, dt = _throughput(s, steps, n, warmup=2)
+        recv = s.read_state("received")
+        return {"msgs_per_sec": round(rate, 0),
+                "ms_per_step": round(dt * 1e3 / steps, 3),
+                "ok": bool((recv == steps + 2).all())}
+
+    for mode in ("merge", "sort", "scatter"):
+        s = BatchedSystem(capacity=n, behaviors=[ring_behavior],
+                          payload_width=PAYLOAD_W, host_inbox=8,
+                          delivery=mode)
+        s.spawn_block(ring_behavior, n)
+        out[mode] = time_sys(s)
+
+    @behavior("ring-slots-bench", {"received": ((), jnp.int32)}, inbox="slots")
+    def ring_slots(state, mailbox, ctx):
+        inbox = mailbox.reduce()
+        nxt = (ctx.actor_id + 1) % ctx.n_actors
+        return ({"received": state["received"] + inbox.count},
+                Emit.single(nxt, inbox.sum, 1, PAYLOAD_W,
+                            when=inbox.count > 0))
+
+    s = BatchedSystem(capacity=n, behaviors=[ring_slots],
+                      payload_width=PAYLOAD_W, host_inbox=8, mailbox_slots=2)
+    s.spawn_block(ring_slots, n)
+    out["slots"] = time_sys(s)
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny config, CPU-ok")
     ap.add_argument("--actors", type=int, default=1 << 20)
     ap.add_argument("--steps", type=int, default=64)
     ap.add_argument("--config", choices=["ring", "ring-dynamic", "fan-in",
-                                         "router", "shard", "shard-api",
-                                         "latency"],
+                                         "router", "router-api", "shard",
+                                         "shard-api", "latency", "modes"],
                     help="run a single config")
     ap.add_argument("--trace", metavar="DIR",
                     help="capture a jax.profiler trace of the run into DIR "
                          "(open with TensorBoard's profile plugin)")
+    ap.add_argument("--probe-timeout", type=float, default=240.0,
+                    help="subprocess backend-probe timeout, seconds")
+    ap.add_argument("--probe-attempts", type=int, default=3)
     args = ap.parse_args()
 
     n = args.actors
@@ -165,14 +300,24 @@ def main() -> None:
     shard_counts = (256, 4096)
     router_counts = (n, 100_000)
     fan_leaves = n
+    mode_steps = 16
     if args.smoke:
         n, steps, lat_rounds = 1 << 12, 8, 20
         shard_counts = (8, 64)
         router_counts = (1 << 12, 100)
         fan_leaves = 1 << 12
+        mode_steps = 4
 
-    import jax
-    dev = jax.devices()[0]
+    extra = {}
+    dev, binfo = _init_backend(args.probe_timeout, args.probe_attempts)
+    extra.update(binfo)
+    if dev is None:
+        # even CPU failed: publish what we know, exit 0 (driver records it)
+        print(f"[bench] FATAL: no usable jax backend: {binfo}", file=sys.stderr)
+        print(json.dumps({"metric": HEADLINE_METRIC, "value": 0,
+                          "unit": "msgs/sec", "vs_baseline": 0.0,
+                          "extra": extra}))
+        return
     print(f"[bench] device: {dev.platform}:{dev.device_kind} "
           f"actors={n} steps={steps}", file=sys.stderr)
 
@@ -184,8 +329,6 @@ def main() -> None:
             atexit.register(stop_trace)
             print(f"[bench] tracing to {args.trace}", file=sys.stderr)
 
-    extra = {}
-
     def run_one(name, fn):
         t0 = time.perf_counter()
         out = fn()
@@ -193,6 +336,14 @@ def main() -> None:
             extra["latency"] = out
             print(f"[bench] latency: p50={out['p50_us']}us "
                   f"p99={out['p99_us']}us", file=sys.stderr)
+            return None
+        if name == "modes":
+            extra["modes"] = out
+            for m, r in out.items():
+                print(f"[bench] modes.{m}: {r['msgs_per_sec']/1e6:.1f}M msg/s "
+                      f"({r['ms_per_step']} ms/step) "
+                      f"correct={'OK' if r['ok'] else 'FAIL'}",
+                      file=sys.stderr)
             return None
         rate, dt, ok = out
         extra[name] = {"msgs_per_sec": round(rate, 0), "ok": ok}
@@ -207,16 +358,19 @@ def main() -> None:
         "ring-dynamic": lambda: bench_ring(n, steps, static=False),
         "fan-in": lambda: bench_fan_in(fan_leaves, steps),
         "router": lambda: bench_router(*router_counts, steps),
+        "router-api": lambda: bench_router_api(*router_counts, steps),
         "shard": lambda: bench_cross_shard(*shard_counts, steps),
         "shard-api": lambda: bench_shard_api(*shard_counts, steps),
         "latency": lambda: bench_latency(lat_rounds),
+        "modes": lambda: bench_modes(n, mode_steps),
     }
 
     metric_names = {
-        "ring": "actor.tell() throughput, 1M-actor ring (uniform 1-msg mailbox)",
+        "ring": HEADLINE_METRIC,
         "ring-dynamic": "actor.tell() throughput, 1M-actor ring (dynamic delivery)",
         "fan-in": "actor.tell() throughput, 1M->1k fan-in",
         "router": "actor.tell() throughput, RoundRobinPool 100k routees",
+        "router-api": "actor.tell() throughput, RoundRobinPool 100k routees (routing API)",
         "shard": "actor.tell() throughput, 256x4k cross-shard",
         "shard-api": "actor.tell() throughput, 256x4k cross-shard (sharding API)",
     }
@@ -225,7 +379,16 @@ def main() -> None:
         print(json.dumps({
             "metric": "mailbox-to-receive latency, 2-actor ping-pong (p50)",
             "value": out["p50_us"], "unit": "us",
-            "vs_baseline": 1.0, "extra": {"latency": out}}))
+            "vs_baseline": 1.0, "extra": {"latency": out, **extra}}))
+        return
+    if args.config == "modes":
+        out = bench_modes(n, mode_steps)
+        best = max(r["msgs_per_sec"] for r in out.values())
+        print(json.dumps({
+            "metric": "delivery-mode comparison, dynamic ring (best mode)",
+            "value": best, "unit": "msgs/sec",
+            "vs_baseline": round(best / BASELINE_MSGS_PER_SEC, 2),
+            "extra": {"modes": out, **extra}}))
         return
     if args.config:
         headline = run_one(args.config, configs[args.config])
@@ -235,21 +398,27 @@ def main() -> None:
             "vs_baseline": round(headline / BASELINE_MSGS_PER_SEC, 2),
             "extra": extra}))
         return
-    else:
-        headline = run_one("ring", configs["ring"])
-        for name in ("ring-dynamic", "fan-in", "router", "shard",
-                     "shard-api", "latency"):
-            try:
-                run_one(name, configs[name])
-            except Exception as e:  # noqa: BLE001 — partial surface > none
-                extra[name] = {"error": repr(e)[:200]}
-                print(f"[bench] {name}: ERROR {e!r}", file=sys.stderr)
+
+    # full surface: every config individually guarded; ALWAYS print the
+    # JSON line and exit 0 so the driver records whatever did run
+    headline = None
+    for name in ("ring", "ring-dynamic", "fan-in", "router", "router-api",
+                 "shard", "shard-api", "latency", "modes"):
+        try:
+            rate = run_one(name, configs[name])
+        except Exception as e:  # noqa: BLE001 — partial surface > none
+            extra[name] = {"error": repr(e)[:200]}
+            print(f"[bench] {name}: ERROR {e!r}", file=sys.stderr)
+            continue
+        if headline is None and rate is not None:
+            headline = rate
 
     print(json.dumps({
-        "metric": "actor.tell() throughput, 1M-actor ring (uniform 1-msg mailbox)",
-        "value": round(headline, 0),
+        "metric": HEADLINE_METRIC,
+        "value": round(headline, 0) if headline is not None else 0,
         "unit": "msgs/sec",
-        "vs_baseline": round(headline / BASELINE_MSGS_PER_SEC, 2),
+        "vs_baseline": (round(headline / BASELINE_MSGS_PER_SEC, 2)
+                        if headline is not None else 0.0),
         "extra": extra,
     }))
 

@@ -57,6 +57,88 @@ def test_reused_row_starts_fresh_and_scrubs_stale_messages():
     assert (s.read_state("n", fresh) == 0).all()
 
 
+def test_generation_guards_stop_respawn_race():
+    """VERDICT r2 #4: per-row incarnation generations. A tell pinned to the
+    OLD incarnation of a row, staged after the row was recycled to a new
+    occupant, dead-letters instead of reaching the new actor
+    (ActorCell.scala:382-388 uid-in-path parity)."""
+    s = BatchedSystem(capacity=4, behaviors=[counter], payload_width=P,
+                      host_inbox=8)
+    ids = s.spawn_block(counter, 4)
+    gen0 = s.generation_of(ids)
+    assert (gen0 == 0).all()
+    dead = []
+    s.on_dead_letter = dead.append
+
+    # same-incarnation tell delivers
+    s.tell(int(ids[0]), [1.0, 0, 0, 0], expect_gen=int(gen0[0]))
+    s.step()
+    s.block_until_ready()
+    assert s.read_state("n", ids[:1])[0] == 1
+
+    # recycle the row: stop bumps the generation, respawn reuses the slot
+    s.stop_block(ids[:1])
+    fresh = s.spawn_block(counter, 1)
+    assert int(fresh[0]) == int(ids[0])      # same row, new incarnation
+    assert s.generation_of(fresh)[0] == 1
+
+    # the RACE: a tell carrying the old incarnation arrives after respawn —
+    # it must dead-letter, never reach the new occupant
+    s.tell(int(ids[0]), [1.0, 0, 0, 0], expect_gen=int(gen0[0]))
+    s.step()
+    s.block_until_ready()
+    assert s.read_state("n", fresh)[0] == 0  # new occupant untouched
+    assert s.dead_lettered == 1
+    assert dead == [1]
+
+    # a gen-pinned tell to the NEW incarnation still delivers
+    s.tell(int(fresh[0]), [1.0, 0, 0, 0],
+           expect_gen=int(s.generation_of(fresh)[0]))
+    s.step()
+    s.block_until_ready()
+    assert s.read_state("n", fresh)[0] == 1
+
+
+def test_device_ref_pins_incarnation():
+    """The bridge-level form of the same guarantee: a DeviceActorRef captured
+    before stop+respawn dead-letters its tells and fails its asks fast."""
+    from akka_tpu import ActorSystem
+    from akka_tpu.batched.bridge import (DeviceDeadLetters, device_props,
+                                         get_handle)
+
+    @behavior("gen-counter8", {"n": ((), jnp.float32)}, inbox="slots")
+    def counter8(state, mailbox, ctx):
+        inbox = mailbox.reduce()
+        return {"n": state["n"] + inbox.count}, Emit.none(1, 8)
+
+    sys_ = ActorSystem.create("genpin", {
+        "akka": {"stdout-loglevel": "OFF", "log-dead-letters": 0}})
+    try:
+        ref = sys_.actor_of(device_props(counter8), "pinned")
+        h = get_handle(sys_)
+        seen = []
+        sys_.event_stream.subscribe(seen.append, DeviceDeadLetters)
+        row = int(ref.rows[0]) if hasattr(ref, "rows") else ref.row
+        old = ref[0] if hasattr(ref, "rows") else ref
+        old.stop()  # bumps the row's generation 0 -> 1
+        assert int(h.generation_of(row)[0]) == 1
+        # the stale per-row ref was stopped locally -> host dead letters;
+        # build a stale-incarnation ref directly to hit the generation path
+        # (what a ref captured before the stop looks like to the runtime)
+        from akka_tpu.batched.bridge import DeviceActorRef
+        stale = DeviceActorRef(sys_, h, row, old.path, gen=0)
+        stale.tell([1.0, 0, 0, 0])
+        import time as _t
+        _t.sleep(0.3)
+        assert h.runtime.dead_lettered >= 1
+        assert seen and isinstance(seen[0], DeviceDeadLetters)
+        with pytest.raises(Exception):
+            stale.ask([1.0, 0, 0, 0], timeout=1.0).result(2.0)
+    finally:
+        sys_.terminate()
+        sys_.await_termination(10.0)
+
+
 def test_device_become_switches_behavior():
     @behavior("flipper", {"n": ((), jnp.int32), "_become": ((), jnp.int32)})
     def flipper(state, inbox, ctx):

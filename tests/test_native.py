@@ -185,7 +185,7 @@ def test_batched_system_uses_native_stager():
     assert len(sys_._stager) == 0
     import numpy as _np
     valid = _np.asarray(sys_.inbox_valid)
-    base = sys_.capacity * sys_.out_degree
+    base = sys_.spill_cap + sys_.capacity * sys_.out_degree
     assert valid[base:base + 8].all()
 
 

@@ -301,6 +301,89 @@ def test_cluster_router_group_selects_remote_paths(two_node_cluster):
     assert homes == {"crt0", "crt1"}
 
 
+@register_deployable
+class SpawnerParent(Actor):
+    """Spawns/stops a remote-deployed child named 'rc' on demand."""
+
+    def __init__(self, remote_addr):
+        super().__init__()
+        self.remote_addr = remote_addr
+
+    def receive(self, message):
+        if message == "spawn":
+            self.context.actor_of(
+                Props.create(WhereAmI, "rc-child").with_deploy(
+                    Deploy(scope=RemoteScope(self.remote_addr))), "rc")
+            self.sender.tell("spawned")
+        elif message == "stop-child":
+            child = self.context.child("rc")
+            if child is not None:
+                self.context.stop(child)
+            self.sender.tell("stopping")
+        elif message == "has-child":
+            self.sender.tell(self.context.child("rc") is not None)
+
+
+def test_remote_child_name_freed_after_termination(two_systems):
+    """ADVICE r2 (cell.py:143): a terminated remote-deployed child must leave
+    _remote_children — the name becomes reusable instead of raising
+    InvalidActorNameException forever."""
+    a, b = two_systems
+    parent = a.actor_of(Props.create(SpawnerParent, addr_of(b)), "sp-parent")
+    assert ask_sync(parent, "spawn", timeout=5.0, system=a) == "spawned"
+    assert ask_sync(parent, "has-child", timeout=5.0, system=a) is True
+    ask_sync(parent, "stop-child", timeout=5.0, system=a)
+    await_condition(
+        lambda: ask_sync(parent, "has-child", timeout=5.0, system=a) is False,
+        max_time=10.0, message="remote child name never freed")
+    # the regression: this second spawn raised InvalidActorNameException
+    assert ask_sync(parent, "spawn", timeout=5.0, system=a) == "spawned"
+
+
+def test_selection_resolves_remote_deployed_child(two_systems):
+    """ADVICE r2 (cell.py:111): get_single_child must consult
+    _remote_children so a selection to the child's logical /user path
+    reaches the remote-deployed actor instead of dead-lettering."""
+    a, b = two_systems
+    parent = a.actor_of(Props.create(SpawnerParent, addr_of(b)), "sel-parent")
+    assert ask_sync(parent, "spawn", timeout=5.0, system=a) == "spawned"
+    sel = a.actor_selection("akka://depA/user/sel-parent/rc")
+    tag, sysname, _path = ask_sync(sel, "where", timeout=5.0, system=a)
+    assert sysname == "depB"
+
+
+def test_cluster_router_pool_settings_validated():
+    """ADVICE r2 (cluster/routing.py:33): reference throws for non-positive
+    capacity settings."""
+    with pytest.raises(ValueError):
+        ClusterRouterPoolSettings(total_instances=0)
+    with pytest.raises(ValueError):
+        ClusterRouterPoolSettings(total_instances=4, max_instances_per_node=0)
+    with pytest.raises(ValueError):
+        ClusterRouterGroupSettings(total_instances=0)
+
+
+def test_cluster_router_pool_spreads_least_loaded(two_node_cluster):
+    """ADVICE r2 (cluster/routing.py:200): with total < nodes * per-node max,
+    routees must spread one-per-node (selectDeploymentTarget order), not pack
+    the lexicographically smallest address."""
+    systems, clusters = two_node_cluster
+    a, b = systems
+    router = a.actor_of(
+        Props.create(WhereAmI, "spread").with_router(ClusterRouterPool(
+            RoundRobinPool(0),
+            ClusterRouterPoolSettings(total_instances=2,
+                                      max_instances_per_node=2))),
+        "spread-pool")
+    await_condition(lambda: _routee_count(a, router) == 2, max_time=10.0,
+                    message="pool did not reach 2 routees")
+    homes = set()
+    for _ in range(6):
+        _, sysname, _ = ask_sync(router, "where", timeout=5.0, system=a)
+        homes.add(sysname)
+    assert homes == {"crt0", "crt1"}, f"routees packed onto {homes}"
+
+
 def test_cluster_router_removes_downed_node(two_node_cluster):
     systems, clusters = two_node_cluster
     a, b = systems

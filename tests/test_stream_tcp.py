@@ -1,0 +1,102 @@
+"""TCP as stream stages (VERDICT r2 #5): Tcp().bind / outgoing_connection
+over the actor-IO layer, including framing through a connection Flow.
+
+Reference: scaladsl/Tcp.scala:105 (outgoingConnection), :210-245 (bind),
+akka-stream-tests TcpSpec echo patterns."""
+
+import socket
+import time
+
+import pytest
+
+from akka_tpu import ActorSystem
+from akka_tpu.stream.dsl import Flow, Keep, Sink, Source
+from akka_tpu.stream.framing import Framing
+from akka_tpu.stream.tcp import IncomingConnection, Tcp
+
+
+@pytest.fixture()
+def system():
+    s = ActorSystem("streamtcp", {"akka": {"stdout-loglevel": "OFF"}})
+    yield s
+    s.terminate()
+    s.await_termination(10)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_bind_and_outgoing_connection_echo(system):
+    port = free_port()
+    tcp = Tcp.get(system)
+
+    # echo server: every accepted connection's bytes are uppercased back
+    def handle(conn: IncomingConnection):
+        conn.handle_with(Flow().map(lambda b: b.upper()), system)
+
+    binding_src = tcp.bind("127.0.0.1", port)
+    binding_fut = binding_src.to_mat(Sink.foreach(handle), Keep.left) \
+        .run(system)
+    binding = binding_fut.result(5.0)
+    assert binding.local_address[1] == port
+
+    # client: one round-trip through the connection Flow
+    out = Source.single(b"hello") \
+        .via(tcp.outgoing_connection("127.0.0.1", port)) \
+        .take(1).run_with(Sink.seq(), system).result(10.0)
+    assert b"".join(out) == b"HELLO"
+    binding.unbind()
+
+
+def test_framing_roundtrip_through_tcp_flow(system):
+    """VERDICT done-criterion: framing round-trips through a Tcp stream
+    Flow (not just a raw socket)."""
+    port = free_port()
+    tcp = Tcp.get(system)
+
+    # server: delimiter-framed lines, reversed per frame, re-delimited
+    def handle(conn: IncomingConnection):
+        conn.handle_with(
+            Framing.delimiter(b"\n", 1024)
+            .map(lambda line: line[::-1] + b"\n"),
+            system)
+
+    tcp.bind("127.0.0.1", port).to_mat(Sink.foreach(handle), Keep.left) \
+        .run(system).result(5.0)
+
+    frames = Source.from_iterable([b"abc\nde", b"f\n"]) \
+        .via(tcp.outgoing_connection("127.0.0.1", port)) \
+        .via(Framing.delimiter(b"\n", 1024)) \
+        .take(2).run_with(Sink.seq(), system).result(10.0)
+    assert frames == [b"cba", b"fed"]
+
+
+def test_outgoing_connection_mat_value_and_refused(system):
+    port = free_port()
+    tcp = Tcp.get(system)
+    fut = Source.single(b"x") \
+        .via_mat(tcp.outgoing_connection("127.0.0.1", port), Keep.right) \
+        .to_mat(Sink.ignore(), Keep.left).run(system)
+    assert isinstance(fut.exception(10.0), ConnectionError)
+
+
+def test_many_frames_with_write_backpressure(system):
+    port = free_port()
+    tcp = Tcp.get(system)
+
+    def handle(conn: IncomingConnection):
+        conn.handle_with(Flow(), system)  # plain echo
+
+    tcp.bind("127.0.0.1", port).to_mat(Sink.foreach(handle), Keep.left) \
+        .run(system).result(5.0)
+
+    n = 200
+    payload = [b"%04d\n" % i for i in range(n)]
+    frames = Source.from_iterable(payload) \
+        .via(tcp.outgoing_connection("127.0.0.1", port)) \
+        .via(Framing.delimiter(b"\n", 64)) \
+        .take(n).run_with(Sink.seq(), system).result(15.0)
+    assert frames == [b"%04d" % i for i in range(n)]
