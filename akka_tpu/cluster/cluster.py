@@ -48,7 +48,21 @@ class Cluster:
         fd_cfg = cfg.get_config("failure-detector")
         self.self_unique_address = UniqueAddress(
             str(provider.local_address), provider.uid)
-        self.self_roles = frozenset(cfg.get("roles", []) or [])
+        # the data center rides the roles set as `dc-<name>` (reference:
+        # ClusterSettings.DcRolePrefix; multi-DC membership per
+        # CrossDcClusterHeartbeat.scala / MembershipState per-DC logic).
+        # Natural TPU mapping: one DC per slice/pod, DCN between DCs.
+        self.self_data_center = cfg.get_string(
+            "multi-data-center.self-data-center", "default")
+        self.self_roles = frozenset(cfg.get("roles", []) or []) | \
+            frozenset({f"dc-{self.self_data_center}"})
+        mdc = cfg.get_config("multi-data-center")
+        self.cross_dc_settings = {
+            "monitoring_members": mdc.get_int(
+                "cross-dc-connections", 2),
+            "interval_factor": max(1, mdc.get_int(
+                "cross-dc-heartbeat-interval-factor", 3)),
+        }
         self.fd_factory = lambda: PhiAccrualFailureDetector(
             threshold=fd_cfg.get_float("threshold", 8.0),
             max_sample_size=fd_cfg.get_int("max-sample-size", 1000),

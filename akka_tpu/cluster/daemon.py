@@ -117,6 +117,13 @@ class ClusterCoreDaemon(Actor):
         self.cluster = cluster
         self.self_node: UniqueAddress = cluster.self_unique_address
         self.roles: FrozenSet[str] = cluster.self_roles
+        # multi-DC: leader actions / heartbeat ring / reaping are PER-DC
+        # (CrossDcClusterHeartbeat.scala:39; one DC per TPU slice/pod)
+        self.dc: str = getattr(cluster, "self_data_center", "default")
+        self._cross_dc = getattr(cluster, "cross_dc_settings",
+                                 {"monitoring_members": 2,
+                                  "interval_factor": 3})
+        self._hb_tick_count = 0
         self.gossip = Gossip()
         self.fd = FailureDetectorRegistry(cluster.fd_factory)
         self._tasks = []
@@ -347,14 +354,18 @@ class ClusterCoreDaemon(Actor):
     def _leader_actions(self) -> None:
         if self._removed or not self.gossip.members:
             return
-        leader = self.gossip.leader(self.self_node)
+        # per-DC leadership: each data center's (lowest-address) leader
+        # promotes/removes ITS OWN members only (MembershipState.leaderOf)
+        leader = self.gossip.leader(self.self_node, dc=self.dc)
         if leader != self.self_node:
             return
         changed = False
         removed_nodes = []
-        if self.gossip.convergence(self.self_node):
+        if self.gossip.convergence(self.self_node, dc=self.dc):
             up_number = self.gossip.youngest_up_number
             for m in list(self.gossip.members):
+                if m.data_center != self.dc:
+                    continue
                 if m.status in (MemberStatus.JOINING, MemberStatus.WEAKLY_UP):
                     up_number += 1
                     self.gossip = self.gossip.with_member(
@@ -373,7 +384,8 @@ class ClusterCoreDaemon(Actor):
             # no convergence (unreachable nodes): still let joiners in weakly
             unreachable = self.gossip.reachability.all_unreachable
             for m in list(self.gossip.members):
-                if (m.status is MemberStatus.JOINING
+                if (m.data_center == self.dc
+                        and m.status is MemberStatus.JOINING
                         and m.unique_address not in unreachable):
                     self.gossip = self.gossip.with_member(
                         m.copy_with(MemberStatus.WEAKLY_UP))
@@ -383,10 +395,12 @@ class ClusterCoreDaemon(Actor):
             reachable_seen = {n for n in self.gossip.seen if n not in unreachable}
             reachable_members = {m.unique_address for m in self.gossip.members
                                  if m.unique_address not in unreachable
+                                 and m.data_center == self.dc
                                  and m.status in (MemberStatus.UP, MemberStatus.LEAVING)}
             if reachable_members <= reachable_seen:
                 for m in list(self.gossip.members):
-                    if m.status is MemberStatus.DOWN:
+                    if m.status is MemberStatus.DOWN \
+                            and m.data_center == self.dc:
                         self.gossip = self.gossip.without_member(m)
                         self._publish_removed(m)
                         removed_nodes.append(m.unique_address)
@@ -401,12 +415,18 @@ class ClusterCoreDaemon(Actor):
                 if node != self.self_node:
                     self._send_to(node, GossipEnvelope(self.self_node, self.gossip))
 
-    # -- heartbeats + reaping (reference: ClusterHeartbeat.scala, :1413) -------
+    # -- heartbeats + reaping (reference: ClusterHeartbeat.scala — ring is
+    # PER-DC; CrossDcClusterHeartbeat.scala:39 — the oldest members of each
+    # DC also monitor the oldest members of the other DCs at a lower rate) --
+    def _alive_members(self) -> list:
+        return [m for m in self.gossip.members
+                if m.status in (MemberStatus.JOINING, MemberStatus.WEAKLY_UP,
+                                MemberStatus.UP, MemberStatus.LEAVING)]
+
     def _neighbors(self) -> list:
-        alive = [m.unique_address for m in self.gossip.members
+        alive = [m.unique_address for m in self._alive_members()
                  if m.unique_address != self.self_node
-                 and m.status in (MemberStatus.JOINING, MemberStatus.WEAKLY_UP,
-                                  MemberStatus.UP, MemberStatus.LEAVING)]
+                 and m.data_center == self.dc]
         if not alive:
             return []
         from ..utils.hashing import stable_hash
@@ -421,8 +441,36 @@ class ClusterCoreDaemon(Actor):
             out.append(ring[(i + step) % len(ring)])
         return out
 
+    def _cross_dc_targets(self) -> list:
+        """Other-DC nodes THIS node monitors: only when self is among the
+        `cross-dc-connections` OLDEST members of its DC, and then the same
+        number of oldest members of every other DC
+        (CrossDcHeartbeatSender.activeReceivers semantics)."""
+        k = self._cross_dc["monitoring_members"]
+        by_dc: Dict[str, list] = {}
+        for m in self._alive_members():
+            by_dc.setdefault(m.data_center, []).append(m)
+        mine = sorted(by_dc.get(self.dc, ()),
+                      key=lambda m: (m.up_number, m.unique_address))
+        if self.self_node not in [m.unique_address for m in mine[:k]]:
+            return []
+        out = []
+        for dc, members in by_dc.items():
+            if dc == self.dc:
+                continue
+            oldest = sorted(members,
+                            key=lambda m: (m.up_number, m.unique_address))[:k]
+            out.extend(m.unique_address for m in oldest)
+        return out
+
     def _heartbeat_tick(self) -> None:
-        for n in self._neighbors():
+        self._hb_tick_count += 1
+        targets = list(self._neighbors())
+        if self._hb_tick_count % self._cross_dc["interval_factor"] == 0:
+            # cross-DC heartbeats ride DCN at a lower rate than the
+            # intra-DC (ICI-local) ring
+            targets += self._cross_dc_targets()
+        for n in targets:
             self._send_to(n, ClusterHeartbeat(self.self_node))
             if not self.fd.is_monitoring(n.address_str):
                 # arm the detector at first send: a neighbor that NEVER
@@ -434,7 +482,7 @@ class ClusterCoreDaemon(Actor):
         if self._removed:
             return
         changed = False
-        monitored = set(self._neighbors())
+        monitored = set(self._neighbors()) | set(self._cross_dc_targets())
         currently_unreachable = self.gossip.reachability.all_unreachable_from(
             self.self_node)
         for n in monitored:
@@ -498,7 +546,8 @@ class ClusterCoreDaemon(Actor):
             if m.unique_address in self.gossip.reachability.all_unreachable)
         return CurrentClusterState(
             members=self.gossip.members, unreachable=unreachable,
-            leader=self.gossip.leader(self.self_node), seen_by=self.gossip.seen)
+            leader=self.gossip.leader(self.self_node, dc=self.dc),
+            seen_by=self.gossip.seen)
 
     def _publish_removed(self, m: Member) -> None:
         self.context.system.event_stream.publish(
@@ -546,7 +595,7 @@ class ClusterCoreDaemon(Actor):
                 es.publish(ReachableMember(m))
         self._published_unreachable = unreachable
         # leader
-        leader = self.gossip.leader(self.self_node)
+        leader = self.gossip.leader(self.self_node, dc=self.dc)
         if leader != self._published_leader:
             self._published_leader = leader
             es.publish(LeaderChanged(leader))

@@ -89,27 +89,40 @@ class Gossip:
         return self.version.compare(other.version)
 
     # -- convergence + leader (reference: MembershipState.scala:56) -----------
-    def convergence(self, self_node: UniqueAddress) -> bool:
+    def convergence(self, self_node: UniqueAddress,
+                    dc: Optional[str] = None) -> bool:
+        """With `dc`, PER-DC convergence (the reference's MembershipState
+        convergence over dcMembers): only members of that DC must have seen
+        the gossip, and only that DC's unreachables block — a cross-DC
+        partition must not freeze a healthy DC's leader."""
         unreachable = {n for n in self.reachability.all_unreachable
                        if n != self_node}
         for n in unreachable:
             m = self.member(n)
-            if m is not None and m.status not in _CONVERGENCE_SKIP_UNREACHABLE:
+            if m is not None and m.status not in _CONVERGENCE_SKIP_UNREACHABLE \
+                    and (dc is None or m.data_center == dc):
                 return False
         for m in self.members:
+            if dc is not None and m.data_center != dc:
+                continue
             if m.status in _CONVERGENCE_STATUSES and m.unique_address not in self.seen:
                 return False
         return True
 
-    def leader(self, self_node: UniqueAddress) -> Optional[UniqueAddress]:
+    def leader(self, self_node: UniqueAddress,
+               dc: Optional[str] = None) -> Optional[UniqueAddress]:
         """First reachable member allowed to lead (reference:
-        MembershipState.leader — Up/Leaving preferred, else Joining/WeaklyUp)."""
-        candidates = [m for m in self.members
+        MembershipState.leader — Up/Leaving preferred, else Joining/WeaklyUp).
+        With `dc`, the PER-DATA-CENTER leader (MembershipState.leaderOf over
+        the dcMembers subset): every DC runs its own leader actions."""
+        pool = self.members if dc is None else [
+            m for m in self.members if m.data_center == dc]
+        candidates = [m for m in pool
                       if m.status in (MemberStatus.UP, MemberStatus.LEAVING)
                       and (m.unique_address == self_node
                            or self.reachability.is_reachable(m.unique_address))]
         if not candidates:
-            candidates = [m for m in self.members
+            candidates = [m for m in pool
                           if m.status in (MemberStatus.JOINING, MemberStatus.WEAKLY_UP)
                           and (m.unique_address == self_node
                                or self.reachability.is_reachable(m.unique_address))]

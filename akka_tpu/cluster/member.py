@@ -69,6 +69,16 @@ class Member:
     def address_str(self) -> str:
         return self.unique_address.address_str
 
+    @property
+    def data_center(self) -> str:
+        """The member's data center, encoded as a `dc-<name>` role exactly
+        like the reference (cluster/Member.scala dataCenter: the DC rides
+        the roles set with the ClusterSettings.DcRolePrefix)."""
+        for r in self.roles:
+            if r.startswith("dc-"):
+                return r[3:]
+        return "default"
+
     def copy_with(self, status: MemberStatus, up_number: Optional[int] = None) -> "Member":
         if status not in ALLOWED_TRANSITIONS[self.status] and status != self.status:
             raise ValueError(f"invalid transition {self.status} -> {status} for {self}")

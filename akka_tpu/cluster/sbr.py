@@ -178,6 +178,12 @@ class SplitBrainResolver(Actor):
         self._unreachable: Set[UniqueAddress] = set()
         self._deadline: Optional[float] = None
         self._task = None
+        # when a lease-backed strategy acquires, release it AFTER a safety
+        # margin (reference: SplitBrainResolver.scala:45-55 releases the
+        # lease once the resolution settles; releasing immediately would
+        # let the doomed side acquire and down the survivors, holding it
+        # forever poisons the NEXT partition's decision)
+        self._release_at: Optional[float] = None
 
     def pre_start(self) -> None:
         self._sub = lambda e: self.self_ref.tell(e)
@@ -192,6 +198,12 @@ class SplitBrainResolver(Actor):
 
     def receive(self, message: Any):
         if isinstance(message, UnreachableMember):
+            # SBR is PER-DC (the reference's SBR only acts within its own
+            # data center; cross-DC unreachability — e.g. a DCN partition
+            # between slices — must NOT down an independently-healthy DC)
+            my_dc = getattr(self.cluster, "self_data_center", "default")
+            if message.member.data_center != my_dc:
+                return None
             self._unreachable.add(message.member.unique_address)
             self._deadline = time.monotonic() + self.stable_after
         elif isinstance(message, ReachableMember):
@@ -202,13 +214,21 @@ class SplitBrainResolver(Actor):
             if (self._deadline is not None and self._unreachable
                     and time.monotonic() >= self._deadline):
                 self._act()
+            if self._release_at is not None \
+                    and time.monotonic() >= self._release_at:
+                self._release_at = None
+                release = getattr(self.strategy, "release", None)
+                if release is not None:
+                    release()
         else:
             return NotImplemented
         return None
 
     def _act(self) -> None:
         state = self.cluster.state
-        members = [m for m in state.members if m.status in _CONSIDERED]
+        my_dc = getattr(self.cluster, "self_data_center", "default")
+        members = [m for m in state.members if m.status in _CONSIDERED
+                   and m.data_center == my_dc]
         if not members:
             self._deadline = None
             return
@@ -216,5 +236,9 @@ class SplitBrainResolver(Actor):
             members, set(self._unreachable), self.cluster.self_unique_address)
         for node in decision.down_nodes:
             self.cluster.down(node.address_str)
+        if decision.down_nodes and hasattr(self.strategy, "release"):
+            # hold the lease past the losing side's own decision window,
+            # then free it for future partitions
+            self._release_at = time.monotonic() + 2 * self.stable_after + 2.0
         self._deadline = None
         self._unreachable -= set(decision.down_nodes)

@@ -194,6 +194,17 @@ class ClusterSingletonManager(Actor):
             elif isinstance(event, _Cleanup):
                 self._peer_manager(prev).tell(HandOverToMe(), self.self_ref)
         elif self.state == "Oldest":
+            if self.settings.use_lease and isinstance(event, _Cleanup) \
+                    and getattr(self, "_lease", None) is not None \
+                    and not self._lease.check_lease():
+                # lease LOST while running (TTL expired during a stall —
+                # another node may already be instantiating): stop our
+                # instance immediately and re-race for the lease
+                if self.singleton is not None:
+                    self.context.stop(self.singleton)
+                    self.singleton = None
+                self.state = "BecomingOldest"
+                return
             if leaving or not self._am_oldest():
                 self.state = "WasOldest"
                 new = self._oldest()

@@ -86,15 +86,23 @@ def _read_tlv(data: bytes, off: int) -> Tuple[int, bytes, int]:
 def _decode_oid(value: bytes) -> str:
     if not value:
         raise PEMLoadingException("empty OID")
-    first = value[0]
-    parts = [str(first // 40), str(first % 40)]
+    # every subidentifier — INCLUDING the first — is base-128 with
+    # continuation bits; the first packs (arc1, arc2) as 40*arc1+arc2
+    # with arc1 capped at 2 (X.690: arc1 = 2 whenever the value >= 80,
+    # e.g. OID 2.999 encodes as 88 37)
+    subids = []
     acc = 0
-    for b in value[1:]:
+    for b in value:
         acc = (acc << 7) | (b & 0x7F)
         if not b & 0x80:
-            parts.append(str(acc))
+            subids.append(acc)
             acc = 0
-    return ".".join(parts)
+    if acc:
+        raise PEMLoadingException("truncated OID subidentifier")
+    first = subids[0]
+    arc1 = 2 if first >= 80 else first // 40
+    arc2 = first - 40 * arc1
+    return ".".join([str(arc1), str(arc2)] + [str(s) for s in subids[1:]])
 
 
 _OID_NAMES = {

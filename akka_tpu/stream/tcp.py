@@ -203,6 +203,12 @@ class _TcpConnectionStage(GraphStage):
         logic.pre_start = pre_start  # type: ignore[method-assign]
 
         def post_stop():
+            # the stage can die by cancellation/failure, not only by clean
+            # upstream finish: close the socket explicitly or the
+            # connection actor + selector registration leak until the peer
+            # closes (Close flushes pending writes first)
+            if st["conn"] is not None and not st["closed"]:
+                st["conn"].tell(iotcp.Close(), st["adapter"])
             if st["adapter"] is not None:
                 system.stop(st["adapter"])
         logic.post_stop = post_stop  # type: ignore[method-assign]

@@ -1,0 +1,26 @@
+"""Backend-platform forcing shared by every entry point that must honor an
+explicit JAX_PLATFORMS=cpu request.
+
+An ambient sitecustomize may register a tunneled TPU platform that wins over
+the env var, and a wedged tunnel HANGS (not errors) at first backend init —
+so the cpu request must be applied through the live config BEFORE any
+backend touch. Exact-token match: a priority list like "tpu,cpu" ('prefer
+TPU, fall back') is NOT a cpu-only request and is left alone."""
+
+from __future__ import annotations
+
+import os
+
+
+def force_requested_platform() -> str | None:
+    """Apply JAX_PLATFORMS via jax.config when it names cpu FIRST.
+    Returns the forced platform name, or None if nothing was forced.
+    Safe to call multiple times; must run before the first backend init."""
+    plats = [p.strip() for p in
+             os.environ.get("JAX_PLATFORMS", "").split(",") if p.strip()]
+    if plats and plats[0] == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return "cpu"
+    return None

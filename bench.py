@@ -64,8 +64,9 @@ def _init_backend(probe_timeout: float, attempts: int):
     """
     info = {}
     import jax
-    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
-        jax.config.update("jax_platforms", "cpu")
+
+    from akka_tpu.utils.platform import force_requested_platform
+    if force_requested_platform() == "cpu":
         info["platform"] = "cpu (JAX_PLATFORMS)"
     else:
         ok, detail = False, ""

@@ -1,0 +1,126 @@
+"""Cross-DC membership (VERDICT r2 #9): DC-tagged members, per-DC leaders
+and heartbeat rings, lower-rate cross-DC heartbeats, per-DC reaping/SBR.
+
+Reference: akka-cluster/src/main/scala/akka/cluster/
+CrossDcClusterHeartbeat.scala:39 (CrossDcHeartbeatSender — only the oldest
+members of each DC monitor other DCs), MembershipState per-DC
+leader/convergence. TPU mapping: one DC per slice/pod, DCN between."""
+
+import time
+
+import pytest
+
+from akka_tpu import ActorSystem
+from akka_tpu.cluster import Cluster, MemberStatus
+from akka_tpu.remote.transport import InProcTransport
+from akka_tpu.testkit import await_condition
+
+
+def _cfg(dc):
+    return {"akka": {"actor": {"provider": "cluster"},
+                     "stdout-loglevel": "OFF", "log-dead-letters": 0,
+                     "remote": {"transport": "inproc",
+                                "canonical": {"hostname": "local",
+                                              "port": 0}},
+                     "cluster": {"gossip-interval": "0.05s",
+                                 "leader-actions-interval": "0.05s",
+                                 "unreachable-nodes-reaper-interval": "0.1s",
+                                 "multi-data-center": {
+                                     "self-data-center": dc,
+                                     "cross-dc-connections": 2},
+                                 "failure-detector": {
+                                     "heartbeat-interval": "0.1s",
+                                     "acceptable-heartbeat-pause": "2s"},
+                                 "split-brain-resolver": {
+                                     "active-strategy": "keep-majority",
+                                     "stable-after": "1s"}}}}
+
+
+def _up_count(cluster):
+    return sum(1 for m in cluster.state.members
+               if m.status is MemberStatus.UP)
+
+
+@pytest.fixture()
+def two_dc_cluster():
+    InProcTransport.fault_injector.reset()
+    systems = [ActorSystem.create(f"dc{'ab'[i // 2]}{i % 2}",
+                                  _cfg("east" if i < 2 else "west"))
+               for i in range(4)]
+    clusters = [Cluster.get(s) for s in systems]
+    seed = str(systems[0].provider.local_address)
+    for c in clusters:
+        c.join(seed)
+    await_condition(lambda: all(_up_count(c) == 4 for c in clusters),
+                    max_time=15.0,
+                    message=f"4-node 2-DC cluster did not form: "
+                            f"{[c.state for c in clusters]}")
+    yield systems, clusters
+    for s in systems:
+        s.terminate()
+    for s in systems:
+        s.await_termination(10.0)
+    InProcTransport.fault_injector.reset()
+
+
+def _addr(s):
+    return f"local:{s.provider.local_address.port}"
+
+
+def test_two_dc_cluster_forms_with_dc_tags(two_dc_cluster):
+    systems, clusters = two_dc_cluster
+    state = clusters[0].state
+    dcs = sorted(m.data_center for m in state.members)
+    assert dcs == ["east", "east", "west", "west"]
+    # DC rides the roles set like the reference's dc- prefix
+    assert any(r.startswith("dc-") for m in state.members for r in m.roles)
+
+
+def test_per_dc_leaders(two_dc_cluster):
+    systems, clusters = two_dc_cluster
+    # each node's published leader is its OWN DC's leader
+    east_leaders = {str(clusters[i].state.leader) for i in (0, 1)}
+    west_leaders = {str(clusters[i].state.leader) for i in (2, 3)}
+    assert len(east_leaders) == 1 and len(west_leaders) == 1
+    assert east_leaders != west_leaders
+
+
+def test_cross_dc_partition_does_not_down_anyone(two_dc_cluster):
+    """A DCN partition between DCs marks the other side unreachable but
+    must NOT down it — each DC stays independently healthy (per-DC SBR)."""
+    systems, clusters = two_dc_cluster
+    fi = InProcTransport.fault_injector
+    for i in (0, 1):
+        for j in (2, 3):
+            fi.blackhole(_addr(systems[i]), _addr(systems[j]))
+            fi.blackhole(_addr(systems[j]), _addr(systems[i]))
+    # give reaping + SBR stable-after ample time to (wrongly) fire
+    time.sleep(4.0)
+    for c in clusters:
+        assert len(c.state.members) == 4, c.state
+        assert _up_count(c) == 4, c.state
+    # heal: reachability recovers, nobody was removed
+    fi.reset()
+    await_condition(
+        lambda: all(not c.state.unreachable for c in clusters),
+        max_time=15.0, message="partition never healed")
+
+
+def test_each_dc_reaps_its_own_unreachables(two_dc_cluster):
+    """Kill one west node: WEST's SBR downs it and WEST's leader removes
+    it; east keeps running and simply learns the removal via gossip."""
+    systems, clusters = two_dc_cluster
+    dead = systems[3]
+    dead_addr = str(dead.provider.local_address)
+    dead.provider.shutdown_transport()
+    dead.terminate()
+    assert dead.await_termination(10.0)
+
+    await_condition(
+        lambda: all(len(c.state.members) == 3 for c in clusters[:3]),
+        max_time=25.0,
+        message=f"dead west node never removed: "
+                f"{[c.state for c in clusters[:3]]}")
+    for c in clusters[:3]:
+        assert dead_addr not in {m.address_str for m in c.state.members}
+        assert _up_count(c) == 3

@@ -243,6 +243,86 @@ def test_singleton_waits_for_lease():
         InProcLease.reset_all()
 
 
+def test_sbr_releases_lease_after_resolution(lease_cluster):
+    """Regression (r3 review): the winning decider must RELEASE the SBR
+    lease after the resolution settles, or the next partition's healthy
+    majority would fail its acquire and down itself."""
+    systems, clusters = lease_cluster
+    first = str(systems[0].provider.local_address)
+    for c in clusters:
+        c.join(first)
+    await_condition(lambda: all(_up_count(c) == 3 for c in clusters),
+                    max_time=10.0, message="cluster did not form")
+    addrs = [f"local:{s.provider.local_address.port}" for s in systems]
+    fi = InProcTransport.fault_injector
+    for i in (0, 1):
+        fi.blackhole(addrs[i], addrs[2])
+        fi.blackhole(addrs[2], addrs[i])
+    await_condition(lambda: all(len(c.state.members) == 2
+                                for c in clusters[:2]), max_time=25.0)
+    # after the release window (2*stable_after + 2s), an outside owner can
+    # take the lease — proof the winner let go
+    probe = InProcLease(LeaseSettings(
+        "sbr-test-lease", "probe",
+        TimeoutSettings(heartbeat_interval=10.0, heartbeat_timeout=2.0)))
+    await_condition(probe.acquire, max_time=15.0,
+                    message="SBR lease never released after resolution")
+    probe.release()
+
+
+def test_singleton_steps_down_on_lease_loss():
+    """Regression (r3 review): a running lease-guarded singleton whose
+    lease EXPIRES (stalled heartbeat) must stop its instance when another
+    owner takes the lease — never two concurrent instances."""
+    from akka_tpu.actor.actor import Actor
+    from akka_tpu.cluster_tools.singleton import (ClusterSingletonManager,
+                                                  ClusterSingletonSettings)
+
+    InProcTransport.fault_injector.reset()
+    InProcLease.reset_all()
+    alive = []
+
+    class TheOne(Actor):
+        def pre_start(self):
+            alive.append(self)
+
+        def post_stop(self):
+            alive.remove(self)
+
+        def receive(self, message):
+            pass
+
+    s = ActorSystem.create("stepdown", LEASE_FAST)
+    try:
+        Cluster.get(s).join(str(s.provider.local_address))
+        await_condition(lambda: _up_count(Cluster.get(s)) == 1, max_time=10.0)
+        s.actor_of(Props.create(
+            ClusterSingletonManager, Props.create(TheOne),
+            ClusterSingletonSettings(singleton_name="sd", use_lease=True,
+                                     lease_name="stepdown-lease")),
+            "sd-manager")
+        await_condition(lambda: len(alive) == 1, max_time=10.0,
+                        message="singleton never started")
+        # simulate a stalled holder: expire the record, let a rival take it
+        with InProcLease._lock:
+            InProcLease._table["stepdown-lease"].deadline = 0.0
+        rival = InProcLease(LeaseSettings(
+            "stepdown-lease", "rival",
+            TimeoutSettings(heartbeat_interval=0.2, heartbeat_timeout=30.0)))
+        assert rival.acquire()
+        await_condition(lambda: len(alive) == 0, max_time=10.0,
+                        message="singleton kept running without the lease")
+        # rival lets go: the manager re-acquires and restarts the instance
+        rival.release()
+        await_condition(lambda: len(alive) == 1, max_time=10.0,
+                        message="singleton never came back")
+    finally:
+        s.terminate()
+        s.await_termination(10.0)
+        InProcTransport.fault_injector.reset()
+        InProcLease.reset_all()
+
+
 # -- device shard rebalance lease --------------------------------------------
 
 def test_device_rebalance_requires_lease():

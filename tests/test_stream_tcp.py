@@ -83,6 +83,36 @@ def test_outgoing_connection_mat_value_and_refused(system):
     assert isinstance(fut.exception(10.0), ConnectionError)
 
 
+def test_connection_closed_when_stage_cancelled(system):
+    """Regression (r3 review): a stage that dies by CANCELLATION (take(1))
+    must close its socket — the connection actor under the IO-TCP manager
+    must not leak."""
+    port = free_port()
+    tcp = Tcp.get(system)
+
+    def handle(conn: IncomingConnection):
+        conn.handle_with(Flow(), system)
+
+    tcp.bind("127.0.0.1", port).to_mat(Sink.foreach(handle), Keep.left) \
+        .run(system).result(5.0)
+
+    from akka_tpu.io.tcp import Tcp as IoTcp
+    manager_ref = IoTcp.get(system).manager
+    baseline = len(manager_ref.cell._children)
+
+    out = Source.single(b"ping") \
+        .via(tcp.outgoing_connection("127.0.0.1", port)) \
+        .take(1).run_with(Sink.seq(), system).result(10.0)
+    assert out == [b"ping"]
+
+    def drained():
+        return len(manager_ref.cell._children) <= baseline
+    deadline = time.time() + 5.0
+    while time.time() < deadline and not drained():
+        time.sleep(0.1)
+    assert drained(), "connection actor leaked after stage stop"
+
+
 def test_many_frames_with_write_backpressure(system):
     port = free_port()
     tcp = Tcp.get(system)

@@ -1,0 +1,199 @@
+"""Stress depth (VERDICT r2 #8): dispatcher consistency under thread
+pressure, a supervision-hierarchy restart storm, and the bank-account
+device-vs-host oracle at 1M rows (bench-gated).
+
+Reference: akka-actor-tests/src/test/scala/akka/actor/ConsistencySpec.scala
+(shared-counter actors hammered from many threads — the memory-model
+discipline test; SURVEY.md §5 race-detection strategy) and
+SupervisorHierarchySpec.scala (randomized failure storm through a
+supervision tree that must heal)."""
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from akka_tpu import Actor, ActorSystem, Props, ask_sync
+
+
+@pytest.fixture()
+def system():
+    s = ActorSystem.create("stress", {"akka": {"stdout-loglevel": "OFF",
+                                               "log-dead-letters": 0}})
+    yield s
+    s.terminate()
+    assert s.await_termination(15.0)
+
+
+class CountingActor(Actor):
+    """The ConsistencySpec shape: unsynchronized internal state that is
+    only safe if the dispatcher provides happens-before between message
+    invocations and never runs two receives concurrently."""
+
+    def __init__(self):
+        super().__init__()
+        self.count = 0
+        self.in_receive = False
+        self.violations = 0
+
+    def receive(self, message):
+        if message == "inc":
+            # detect concurrent entry (would mean two threads in receive)
+            if self.in_receive:
+                self.violations += 1
+            self.in_receive = True
+            c = self.count
+            # widen the race window: read-modify-write with a reschedule
+            if c % 64 == 0:
+                time.sleep(0)
+            self.count = c + 1
+            self.in_receive = False
+        elif message == "get":
+            self.sender.tell((self.count, self.violations))
+
+
+def test_dispatcher_consistency_under_thread_pressure(system):
+    """ConsistencySpec.scala parity: T producer threads hammer A actors;
+    every increment must land exactly once and no receive may overlap."""
+    n_actors, n_threads, per_thread = 8, 8, 2000
+    refs = [system.actor_of(Props.create(CountingActor), f"cons-{i}")
+            for i in range(n_actors)]
+
+    def producer(tid):
+        rng = random.Random(tid)
+        for _ in range(per_thread):
+            refs[rng.randrange(n_actors)].tell("inc")
+
+    threads = [threading.Thread(target=producer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        got = [ask_sync(r, "get", timeout=10.0, system=system) for r in refs]
+        total = sum(c for c, _v in got)
+        if total == n_threads * per_thread:
+            break
+        time.sleep(0.1)
+    got = [ask_sync(r, "get", timeout=10.0, system=system) for r in refs]
+    assert sum(c for c, _v in got) == n_threads * per_thread, got
+    assert all(v == 0 for _c, v in got), f"overlapping receives: {got}"
+
+
+class StormChild(Actor):
+    """Leaf that fails on demand and counts its own restarts via a fresh
+    instance each time (state resets on restart, as Props re-instantiates)."""
+
+    def receive(self, message):
+        if message == "boom":
+            raise RuntimeError("storm")
+        if message == "ping":
+            self.sender.tell("pong")
+
+
+class StormSupervisor(Actor):
+    """Mid-tier supervisor: default strategy restarts failing children."""
+
+    def __init__(self, n_children):
+        super().__init__()
+        self.n_children = n_children
+
+    def pre_start(self):
+        for i in range(self.n_children):
+            self.context.actor_of(Props.create(StormChild), f"child-{i}")
+
+    def receive(self, message):
+        if message == "ping":
+            self.sender.tell("pong")
+
+
+def test_supervision_hierarchy_restart_storm(system):
+    """SupervisorHierarchySpec parity: a 3-level tree (1 root supervisor,
+    S mid supervisors, S*C leaves) bombarded with random failures
+    interleaved with traffic; afterwards EVERY leaf must answer — the tree
+    healed, nothing deadlocked, no child was lost."""
+    S, C, failures = 4, 8, 400
+    sups = [system.actor_of(Props.create(StormSupervisor, C), f"sup-{i}")
+            for i in range(S)]
+    time.sleep(0.3)  # children spawn
+
+    leaves = [system.actor_selection(f"akka://stress/user/sup-{i}/child-{j}")
+              for i in range(S) for j in range(C)]
+    # warm: every leaf resolves and answers
+    for leaf in leaves:
+        assert ask_sync(leaf, "ping", timeout=10.0, system=system) == "pong"
+
+    rng = random.Random(42)
+    stop = threading.Event()
+
+    def traffic():
+        while not stop.is_set():
+            leaves[rng.randrange(len(leaves))].tell("ping")
+
+    t = threading.Thread(target=traffic)
+    t.start()
+    try:
+        for _ in range(failures):
+            leaves[rng.randrange(len(leaves))].tell("boom")
+            if rng.random() < 0.1:
+                time.sleep(0.001)
+    finally:
+        stop.set()
+        t.join(10.0)
+
+    # the storm settles: every leaf restarted in place and answers again
+    deadline = time.monotonic() + 30.0
+    remaining = list(leaves)
+    while remaining and time.monotonic() < deadline:
+        still = []
+        for leaf in remaining:
+            try:
+                if ask_sync(leaf, "ping", timeout=5.0,
+                            system=system) != "pong":
+                    still.append(leaf)
+            except Exception:  # noqa: BLE001 — retry until deadline
+                still.append(leaf)
+        remaining = still
+    assert not remaining, f"{len(remaining)} leaves never healed"
+    # supervisors themselves never died
+    for s in sups:
+        assert ask_sync(s, "ping", timeout=5.0, system=system) == "pong"
+
+
+@pytest.mark.slow
+def test_bank_account_oracle_at_1m():
+    """VERDICT r2 #8 done-criterion: the device-vs-host bank-account oracle
+    at 1M accounts — exact equality after multi-step spill draining."""
+    import jax.numpy as jnp
+
+    from akka_tpu.batched import BatchedSystem
+    from tests.test_mailbox_slots import bank_oracle, make_account
+
+    rng = np.random.default_rng(23)
+    n = 1 << 20            # 1,048,576 accounts
+    m = 1 << 21            # 2M messages (~2/actor; hot spots overflow slots)
+    dst = rng.integers(0, n, m).astype(np.int32)
+    mtype = rng.integers(0, 3, m).astype(np.int32)
+    amount = rng.integers(1, 100, m).astype(np.float32)
+
+    acct = make_account()
+    s = BatchedSystem(capacity=n, behaviors=[acct], payload_width=4,
+                      host_inbox=m, mailbox_slots=8, native_staging=False)
+    s.spawn_block(acct, n)
+    pl = np.zeros((m, 4), np.float32)
+    pl[:, 0] = amount
+    s.seed_inbox(dst, pl, mtype)
+    for _ in range(6):  # first delivery + spill drain
+        s.step()
+    s.block_until_ready()
+    assert s.pending_messages == 0
+    assert s.mailbox_overflow == 0
+
+    bal_exp, rej_exp = bank_oracle(n, dst, mtype, amount)
+    np.testing.assert_array_equal(s.read_state("balance"), bal_exp)
+    np.testing.assert_array_equal(s.read_state("rejected"), rej_exp)

@@ -65,6 +65,19 @@ def test_pem_decode_errors():
             "-----BEGIN CERTIFICATE-----\nQUJD\n-----END CERTIFICATE-----"))
 
 
+def test_oid_decoding_multibyte_first_arc():
+    """Regression (r3 review): OIDs under joint-iso-itu-t(2) with arc2 >= 40
+    pack the first subidentifier in multiple base-128 bytes; 2.999 is the
+    canonical example (encodes as 88 37)."""
+    from akka_tpu.pki.pem import _decode_oid
+    assert _decode_oid(bytes([0x88, 0x37])) == "2.999"
+    assert _decode_oid(bytes([0x2A, 0x86, 0x48, 0x86, 0xF7, 0x0D, 0x01,
+                              0x01, 0x01])) == "1.2.840.113549.1.1.1"
+    assert _decode_oid(bytes([0x2B, 0x65, 0x70])) == "1.3.101.112"
+    with pytest.raises(PEMLoadingException):
+        _decode_oid(bytes([0x88]))  # dangling continuation bit
+
+
 def test_pem_decode_multiple_blocks(certs):
     chain = (certs / "node0.crt").read_text() + (certs / "ca.crt").read_text()
     blocks = decode_all(chain)
