@@ -54,7 +54,18 @@ class Cluster:
         # Natural TPU mapping: one DC per slice/pod, DCN between DCs.
         self.self_data_center = cfg.get_string(
             "multi-data-center.self-data-center", "default")
-        self.self_roles = frozenset(cfg.get("roles", []) or []) | \
+        user_roles = frozenset(cfg.get("roles", []) or [])
+        reserved = [r for r in user_roles if r.startswith("dc-")]
+        if reserved:
+            # the dc- prefix is RESERVED for the data-center encoding
+            # (reference: ClusterSettings requires roles not start with
+            # the DcRolePrefix); a second dc- role would make
+            # Member.data_center ambiguous
+            raise ValueError(
+                f"cluster roles must not use the reserved 'dc-' prefix "
+                f"(got {reserved}); set "
+                f"akka.cluster.multi-data-center.self-data-center instead")
+        self.self_roles = user_roles | \
             frozenset({f"dc-{self.self_data_center}"})
         mdc = cfg.get_config("multi-data-center")
         self.cross_dc_settings = {

@@ -73,11 +73,11 @@ class Member:
     def data_center(self) -> str:
         """The member's data center, encoded as a `dc-<name>` role exactly
         like the reference (cluster/Member.scala dataCenter: the DC rides
-        the roles set with the ClusterSettings.DcRolePrefix)."""
-        for r in self.roles:
-            if r.startswith("dc-"):
-                return r[3:]
-        return "default"
+        the roles set with the ClusterSettings.DcRolePrefix). Deterministic
+        under multiple dc- roles (sorted) — though Cluster.__init__ rejects
+        user roles with the reserved prefix, wire data is untrusted."""
+        dcs = sorted(r for r in self.roles if r.startswith("dc-"))
+        return dcs[0][3:] if dcs else "default"
 
     def copy_with(self, status: MemberStatus, up_number: Optional[int] = None) -> "Member":
         if status not in ALLOWED_TRANSITIONS[self.status] and status != self.status:

@@ -92,12 +92,16 @@ def _decode_oid(value: bytes) -> str:
     # e.g. OID 2.999 encodes as 88 37)
     subids = []
     acc = 0
+    pending = False
     for b in value:
         acc = (acc << 7) | (b & 0x7F)
-        if not b & 0x80:
+        pending = bool(b & 0x80)
+        if not pending:
             subids.append(acc)
             acc = 0
-    if acc:
+    if pending or not subids:
+        # a trailing continuation byte with a zero payload leaves acc == 0,
+        # so the flag — not acc's truthiness — is the truncation signal
         raise PEMLoadingException("truncated OID subidentifier")
     first = subids[0]
     arc1 = 2 if first >= 80 else first // 40

@@ -198,6 +198,8 @@ class RemoteActorRefProvider(LocalActorRefProvider):
         cfg = self.settings.config
         host = cfg.get_string("akka.remote.canonical.hostname", "127.0.0.1")
         port = cfg.get_int("akka.remote.canonical.port", 0)
+        self.large_message_threshold = cfg.get_int(
+            "akka.remote.large-message-threshold", 32 * 1024)
         kind = cfg.get_string("akka.remote.transport", "tcp")
         if kind == "inproc":
             self.transport = InProcTransport()
@@ -289,13 +291,25 @@ class RemoteActorRefProvider(LocalActorRefProvider):
             if sp.address.has_local_scope and self.local_address is not None:
                 sp = sp.with_address(self.local_address)
             sender_path = sp.to_serialization_format()
+        # lane selection (ArteryTransport.scala:383-428): system messages
+        # ride the control lane; oversized payloads ride a DEDICATED large
+        # lane (own connection) so one big transfer cannot head-of-line
+        # block ordinary traffic. Artery picks by destination config; a
+        # size threshold is the natural form when payloads are on hand.
+        # Like Artery, ordering holds WITHIN a lane, not across lanes.
+        if is_system:
+            lane = "control"
+        elif len(payload) >= self.large_message_threshold:
+            lane = "large"
+        else:
+            lane = "ordinary"
         env = WireEnvelope(
             recipient=ref.path.to_serialization_format(),
             sender=sender_path,
             serializer_id=sid, manifest=manifest, payload=payload,
             is_system=is_system,
             from_address=str(self.local_address), from_uid=self.uid,
-            lane="control" if is_system else "ordinary")
+            lane=lane)
         if is_system:
             with assoc.lock:
                 env.seq = next(assoc.seq)

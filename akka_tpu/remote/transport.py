@@ -238,13 +238,17 @@ class InProcTransport(Transport):
 
 class TcpTransport(Transport):
     """Framed TCP: 4-byte big-endian length + binary WireEnvelope. One
-    outbound connection per peer, kept open (Artery-tcp-like)."""
+    outbound connection per (peer, LANE), kept open — the control /
+    ordinary / large lanes each get their own socket so a multi-megabyte
+    payload in flight on the large lane cannot head-of-line-block
+    heartbeats or ordinary tells (ArteryTransport.scala:383-428 lane
+    partitioning; ordering is per-lane, as in Artery)."""
 
     def __init__(self, local_address: str = ""):
         self.local_address = local_address
         self._server_sock: Optional[socket.socket] = None
-        self._conns: Dict[Tuple[str, int], socket.socket] = {}
-        self._peer_locks: Dict[Tuple[str, int], threading.Lock] = {}
+        self._conns: Dict[Tuple[str, int, str], socket.socket] = {}
+        self._peer_locks: Dict[Tuple[str, int, str], threading.Lock] = {}
         self._conn_lock = threading.Lock()
         self._stop = threading.Event()
         self.fault_injector = FaultInjector()
@@ -311,9 +315,9 @@ class TcpTransport(Transport):
         finally:
             conn.close()
 
-    def _peer_lock(self, key: Tuple[str, int]) -> threading.Lock:
-        # per-peer lock so a slow/blocked connect to one peer doesn't stall
-        # sends (e.g. failure-detector heartbeats) to healthy peers
+    def _peer_lock(self, key: Tuple[str, int, str]) -> threading.Lock:
+        # per-(peer, lane) lock so a slow/blocked transfer on one lane
+        # doesn't stall sends (e.g. failure-detector heartbeats) on others
         with self._conn_lock:
             lock = self._peer_locks.get(key)
             if lock is None:
@@ -325,7 +329,7 @@ class TcpTransport(Transport):
             return False
         data = envelope.to_bytes()
         frame = _LEN.pack(len(data)) + data
-        key = (host, port)
+        key = (host, port, envelope.lane)
         with self._peer_lock(key):
             sock = self._conns.get(key)
             if sock is None:

@@ -48,22 +48,43 @@ class IterableSource(_SourceStage):
 
     def create_logic(self):
         out = self.out
-        it_holder = {}
+        holder = {}
         logic = GraphStageLogic(self._shape)
 
         def on_pull():
-            it = it_holder.get("it")
+            it = holder.get("it")
             if it is None:
-                it = it_holder["it"] = iter(self.iterable)
-            try:
-                elem = next(it)
-            except StopIteration:
+                it = holder["it"] = iter(self.iterable)
+                try:
+                    holder["next"] = next(it)
+                except StopIteration:
+                    logic.complete(out)
+                    return
+                except Exception as e:  # noqa: BLE001
+                    logic.fail(out, e)
+                    return
+            if "err" in holder:
+                logic.fail(out, holder.pop("err"))
+                return
+            if "next" not in holder:
                 logic.complete(out)
                 return
+            elem = holder.pop("next")
+            # one-element lookahead so exhaustion is known NOW and
+            # completion rides WITH the last element — a consumer with
+            # exact demand must not need a bonus pull to learn the stream
+            # ended (reference: Source.fromIterator pushes then checks
+            # hasNext; reactive-streams 1.05 completion-without-demand)
+            done = False
+            try:
+                holder["next"] = next(it)
+            except StopIteration:
+                done = True
             except Exception as e:  # noqa: BLE001
-                logic.fail(out, e)
-                return
+                holder["err"] = e
             logic.push(out, elem)
+            if done:
+                logic.complete(out)
         logic.set_handler(out, make_out_handler(on_pull))
         return logic
 
@@ -776,25 +797,33 @@ class Buffer(_LinearStage):
 
         def on_push():
             elem = logic.grab(in_)
-            if logic.is_available(out):
+            if logic.is_available(out) and not buf:
+                # fast path only with an EMPTY buffer — pushing past
+                # buffered elements would reorder the stream
                 logic.push(out, elem)
                 logic.pull(in_)
                 return
             if len(buf) < size:
                 buf.append(elem)
-                logic.pull(in_)
             elif strategy == "drop_head":
-                buf.popleft(); buf.append(elem); logic.pull(in_)
+                buf.popleft(); buf.append(elem)
             elif strategy == "drop_tail":
-                buf.pop(); buf.append(elem); logic.pull(in_)
+                buf.pop(); buf.append(elem)
             elif strategy == "drop_new":
-                logic.pull(in_)
+                pass
             elif strategy == "drop_buffer":
-                buf.clear(); buf.append(elem); logic.pull(in_)
+                buf.clear(); buf.append(elem)
             elif strategy == "fail":
                 logic.fail_stage(BufferOverflowException(
                     f"buffer full ({size})"))
-            # backpressure: don't pull until space frees up
+                return
+            else:  # backpressure at capacity: the element MUST still be
+                # kept — it was already pulled in-flight when the buffer
+                # filled; only the NEXT pull is withheld
+                buf.append(elem)
+            # keep pulling unless backpressuring at capacity
+            if not (strategy == "backpressure" and len(buf) >= size):
+                logic.pull(in_)
 
         def on_pull():
             if buf:

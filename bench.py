@@ -213,7 +213,11 @@ def bench_shard_api(n_shards, per_shard, steps):
 
 def bench_latency(rounds):
     """Config 1: mailbox-to-receive latency — host tell -> one device step
-    -> processed. The whole visible path, not just the enqueue."""
+    -> processed. The whole visible path, not just the enqueue — broken
+    into components so the number is interpretable on a tunneled backend
+    (VERDICT r2 weak #10): `tell` = staging, `dispatch` = flush + step
+    launch (host-side program dispatch; a tunnel pays RTT here), `block` =
+    device execution + readback sync."""
     from akka_tpu.models.baseline_benches import build_ping_pong
     s = build_ping_pong()
     # warm the exact programs the timed loop uses (flush + single step)
@@ -221,18 +225,31 @@ def bench_latency(rounds):
     s.step()
     s.step()
     s.block_until_ready()
-    samples = []
+    samples, tells, dispatches, blocks = [], [], [], []
     for _ in range(rounds):
         t0 = time.perf_counter()
         s.tell(0, [1.0, 0, 0, 0])
+        t1 = time.perf_counter()
         s.step()
+        t2 = time.perf_counter()
         s.block_until_ready()
-        samples.append(time.perf_counter() - t0)
-    samples.sort()
-    p = lambda q: samples[min(int(q * len(samples)), len(samples) - 1)]
-    return {"p50_us": round(p(0.50) * 1e6, 1),
-            "p99_us": round(p(0.99) * 1e6, 1),
-            "rounds": rounds}
+        t3 = time.perf_counter()
+        samples.append(t3 - t0)
+        tells.append(t1 - t0)
+        dispatches.append(t2 - t1)
+        blocks.append(t3 - t2)
+
+    def pcts(xs):
+        xs = sorted(xs)
+        p = lambda q: xs[min(int(q * len(xs)), len(xs) - 1)]
+        return {"p50_us": round(p(0.50) * 1e6, 1),
+                "p99_us": round(p(0.99) * 1e6, 1)}
+
+    out = pcts(samples)
+    out["rounds"] = rounds
+    out["components"] = {"tell": pcts(tells), "dispatch": pcts(dispatches),
+                         "block": pcts(blocks)}
+    return out
 
 
 def bench_modes(n, steps):

@@ -67,6 +67,30 @@ def _addr(s):
     return f"local:{s.provider.local_address.port}"
 
 
+def test_reserved_dc_role_prefix_rejected():
+    """Regression (r3 review): a user role with the reserved dc- prefix
+    would make data_center ambiguous — refused when the Cluster extension
+    initializes (the extension is lazy, so that is first Cluster.get)."""
+    cfg = _cfg("east")
+    cfg["akka"]["cluster"]["roles"] = ["dc-ops"]
+    s = ActorSystem.create("dcbad", cfg)
+    try:
+        with pytest.raises(ValueError, match="dc-"):
+            Cluster.get(s)
+    finally:
+        s.terminate()
+        s.await_termination(10.0)
+
+
+def test_data_center_deterministic_with_multiple_dc_roles():
+    """Wire data is untrusted: multiple dc- roles resolve deterministically
+    (sorted), never by set iteration order."""
+    from akka_tpu.cluster.member import Member, UniqueAddress
+    m = Member(UniqueAddress("akka://x@h:1", 1),
+               roles=frozenset({"dc-zeta", "dc-alpha", "worker"}))
+    assert m.data_center == "alpha"
+
+
 def test_two_dc_cluster_forms_with_dc_tags(two_dc_cluster):
     systems, clusters = two_dc_cluster
     state = clusters[0].state

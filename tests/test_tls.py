@@ -76,6 +76,10 @@ def test_oid_decoding_multibyte_first_arc():
     assert _decode_oid(bytes([0x2B, 0x65, 0x70])) == "1.3.101.112"
     with pytest.raises(PEMLoadingException):
         _decode_oid(bytes([0x88]))  # dangling continuation bit
+    with pytest.raises(PEMLoadingException):
+        _decode_oid(bytes([0x2A, 0x80]))  # zero-payload dangling byte
+    with pytest.raises(PEMLoadingException):
+        _decode_oid(bytes([0x80]))  # nothing but a continuation byte
 
 
 def test_pem_decode_multiple_blocks(certs):
