@@ -176,6 +176,11 @@ class BatchedSystem:
         self._flush_valid = np.zeros((self.host_inbox,), np.bool_)
         self._flush_jit = jax.jit(self._flush_impl,
                                   donate_argnums=(0, 1, 2, 3))
+        # fused flush+step: ONE program dispatch when host tells are staged
+        # (the tell->receive latency path pays per-dispatch overhead twice
+        # otherwise — on a tunneled backend that is 2x the RTT)
+        self._flush_step_jit = jax.jit(self._flush_step_impl,
+                                       donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
 
         self._core = StepCore(self.behaviors, n_local=self.capacity,
                               payload_width=self.payload_width,
@@ -393,10 +398,7 @@ class BatchedSystem:
                 upd(inbox_valid, valid, (base,)))
 
     def _run_flush(self, k: int) -> None:
-        """Push the filled pad buffers (first k rows meaningful) to device."""
-        self._flush_valid[:k] = True
-        self._flush_valid[k:] = False
-        self._flush_dst[k:] = -1
+        """Dispatch the flush program over pads filled by _drain_to_pad."""
         (self.inbox_dst, self.inbox_type, self.inbox_payload,
          self.inbox_valid) = self._flush_jit(
             self.inbox_dst, self.inbox_type, self.inbox_payload,
@@ -405,37 +407,59 @@ class BatchedSystem:
             jnp.asarray(self._flush_payload, self.payload_dtype),
             jnp.asarray(self._flush_valid))
 
-    def _flush_staged(self) -> None:
+    def _flush_step_impl(self, state, behavior_id, alive, inbox_dst,
+                         inbox_type, inbox_payload, inbox_valid,
+                         mail_dropped, step_count, dsts, mts, pls, valid,
+                         topo_arrays=()):
+        """flush + step as ONE program (the latency hot path)."""
+        inbox_dst, inbox_type, inbox_payload, inbox_valid = self._flush_impl(
+            inbox_dst, inbox_type, inbox_payload, inbox_valid,
+            dsts, mts, pls, valid)
+        return self._step_impl(state, behavior_id, alive, inbox_dst,
+                               inbox_type, inbox_payload, inbox_valid,
+                               mail_dropped, step_count, topo_arrays)
+
+    def _drain_to_pad(self) -> int:
+        """Drain staged host tells (native stager or Python list) into the
+        reusable pad buffers, applying overflow-drop accounting. Returns the
+        number of staged rows (0 = nothing to flush); the pad's valid/dst
+        tails are normalized for dispatch."""
         if self._stager is not None:
             dsts_np, rows_np = self._stager.drain()
             k = dsts_np.shape[0]
             if k == 0:
-                return
+                return 0
             self._flush_dst[:k] = dsts_np
             if self.mailbox_slots > 0:
                 self._flush_type[:k] = self._unpack_type(rows_np[:, 0])
                 self._flush_payload[:k] = rows_np[:, 1:]
             else:
                 self._flush_payload[:k] = rows_np
-            self._run_flush(k)
-            if self.flight_recorder is not None:
-                self.flight_recorder.device_flush("batched", k)
-            return
-        with self._lock:
-            staged, self._host_staged = self._host_staged, []
-        if not staged:
-            return
-        if len(staged) > self.host_inbox:
-            n_drop = len(staged) - self.host_inbox
+        else:
             with self._lock:
-                self._dropped_host += n_drop
-            if self.on_dropped is not None:
-                self.on_dropped(n_drop)
-            staged = staged[: self.host_inbox]
-        k = len(staged)
-        self._flush_dst[:k] = [d for d, _, _ in staged]
-        self._flush_type[:k] = [t for _, t, _ in staged]
-        self._flush_payload[:k] = np.stack([p for _, _, p in staged])
+                staged, self._host_staged = self._host_staged, []
+            if not staged:
+                return 0
+            if len(staged) > self.host_inbox:
+                n_drop = len(staged) - self.host_inbox
+                with self._lock:
+                    self._dropped_host += n_drop
+                if self.on_dropped is not None:
+                    self.on_dropped(n_drop)
+                staged = staged[: self.host_inbox]
+            k = len(staged)
+            self._flush_dst[:k] = [d for d, _, _ in staged]
+            self._flush_type[:k] = [t for _, t, _ in staged]
+            self._flush_payload[:k] = np.stack([p for _, _, p in staged])
+        self._flush_valid[:k] = True
+        self._flush_valid[k:] = False
+        self._flush_dst[k:] = -1
+        return k
+
+    def _flush_staged(self) -> None:
+        k = self._drain_to_pad()
+        if k == 0:
+            return
         self._run_flush(k)
         if self.flight_recorder is not None:
             self.flight_recorder.device_flush("batched", k)
@@ -501,17 +525,30 @@ class BatchedSystem:
          self.mail_dropped, self.step_count) = carry
 
     def step(self) -> None:
-        """One delivery+update step (flushes host tells first)."""
+        """One delivery+update step. Staged host tells ride INSIDE the same
+        program dispatch (the fused flush+step program) — half the per-step
+        overhead of flush-then-step on the tell→receive latency path."""
         from ..event.flight_recorder import trace_span
-        self._flush_staged()
+        k = self._drain_to_pad()  # host-side; excluded from dispatch timing
         t0 = _time.perf_counter()
         with trace_span("akka.device.step"):
-            self._set_carry(self._step_jit(*self._carry(), self._topo_arrays))
+            if k > 0:
+                self._set_carry(self._flush_step_jit(
+                    *self._carry(),
+                    jnp.asarray(self._flush_dst),
+                    jnp.asarray(self._flush_type),
+                    jnp.asarray(self._flush_payload, self.payload_dtype),
+                    jnp.asarray(self._flush_valid), self._topo_arrays))
+            else:
+                self._set_carry(self._step_jit(*self._carry(),
+                                               self._topo_arrays))
         fr = self.flight_recorder
         if fr is not None:
             # elapsed_s is DISPATCH time (launch is async; the device may
             # still be executing) — slow dispatches still flag recompiles
             # and host stalls in a post-mortem flight
+            if k > 0:
+                fr.device_flush("batched", k)
             fr.device_step("batched", 1, _time.perf_counter() - t0)
 
     def run(self, n_steps: int) -> None:
@@ -546,6 +583,14 @@ class BatchedSystem:
             jnp.asarray(self._flush_dst), jnp.asarray(self._flush_type),
             jnp.asarray(self._flush_payload, self.payload_dtype),
             jnp.asarray(self._flush_valid))
+        jax.tree.map(lambda a: a.delete() if hasattr(a, "delete") else None,
+                     out)
+        clone = jax.tree.map(jnp.zeros_like, self._carry())
+        out = self._flush_step_jit(
+            *clone,
+            jnp.asarray(self._flush_dst), jnp.asarray(self._flush_type),
+            jnp.asarray(self._flush_payload, self.payload_dtype),
+            jnp.asarray(self._flush_valid), self._topo_arrays)
         jax.tree.map(lambda a: a.delete() if hasattr(a, "delete") else None,
                      out)
         if self.flight_recorder is not None:
