@@ -24,6 +24,9 @@ _CONSIDERED = {MemberStatus.UP, MemberStatus.LEAVING, MemberStatus.EXITING}
 @dataclass
 class Decision:
     down_nodes: List[UniqueAddress]
+    # True = not decided yet; the resolver must keep the deadline armed and
+    # re-invoke on the next tick (lease-majority's minority delay)
+    retry: bool = False
 
 
 class DowningStrategy:
@@ -106,14 +109,19 @@ class LeaseMajority(DowningStrategy):
     DowningStrategy.LeaseMajority): only each side's lowest-address
     reachable node races for the lease — on success it downs the other
     side, on failure it downs its OWN side; the rest of its side follows
-    the downing through gossip. Works across real processes with the
-    `file` lease backend."""
+    the downing through gossip. The MINORITY side delays its acquire
+    attempt (the reference's acquire-lease-delay-for-minority) so a
+    symmetric partition deterministically favors the majority instead of
+    a coin-flip race. Works across real processes with the `file` lease
+    backend."""
 
-    def __init__(self, lease_factory):
+    def __init__(self, lease_factory, acquire_delay_for_minority: float = 2.0):
         # factory: () -> Lease — deferred so the owner name can carry the
         # node address and the lease is only created when SBR fires
         self._lease_factory = lease_factory
         self._lease = None
+        self.acquire_delay_for_minority = acquire_delay_for_minority
+        self._deferred_until: Optional[float] = None
 
     def decide(self, members, unreachable, self_node):
         reachable, lost = self._sides(members, unreachable)
@@ -122,11 +130,28 @@ class LeaseMajority(DowningStrategy):
         decider = min(m.unique_address for m in reachable)
         if self_node != decider:
             return Decision([])  # our side's decider acts; downs gossip in
+        is_minority = len(reachable) < len(lost) or (
+            len(reachable) == len(lost)
+            and min(m.unique_address for m in members) not in
+            {m.unique_address for m in reachable})
+        if is_minority:
+            now = time.monotonic()
+            if self._deferred_until is None:
+                self._deferred_until = now + self.acquire_delay_for_minority
+            if now < self._deferred_until:
+                return Decision([], retry=True)  # majority gets a head start
+        self._deferred_until = None
         if self._lease is None:
             self._lease = self._lease_factory()
         if self._lease.acquire():
             return self._down_side(lost)
         return self._down_side(reachable)
+
+    def reset(self) -> None:
+        """Partition healed without a decision: clear the episode state so
+        the NEXT partition's minority delay starts fresh (a stale expired
+        _deferred_until would skip the delay entirely)."""
+        self._deferred_until = None
 
     def release(self) -> None:
         if self._lease is not None:
@@ -157,7 +182,8 @@ def strategy_from_config(cfg, system=None, self_owner: str = ""
             return LeaseProvider.get(system).get_lease(
                 lease_name, "akka.cluster.split-brain-resolver.lease-majority",
                 self_owner)
-        return LeaseMajority(factory)
+        return LeaseMajority(factory, cfg.get_duration(
+            "lease-majority.acquire-lease-delay-for-minority", 2.0))
     raise ValueError(f"unknown split-brain-resolver strategy {name!r}")
 
 
@@ -210,6 +236,12 @@ class SplitBrainResolver(Actor):
             self._unreachable.discard(message.member.unique_address)
             self._deadline = (time.monotonic() + self.stable_after
                               if self._unreachable else None)
+            if not self._unreachable:
+                # episode over with no decision: let stateful strategies
+                # (lease-majority's minority delay) start fresh next time
+                reset = getattr(self.strategy, "reset", None)
+                if reset is not None:
+                    reset()
         elif isinstance(message, self._Tick):
             if (self._deadline is not None and self._unreachable
                     and time.monotonic() >= self._deadline):
@@ -234,6 +266,10 @@ class SplitBrainResolver(Actor):
             return
         decision = self.strategy.decide(
             members, set(self._unreachable), self.cluster.self_unique_address)
+        if decision.retry:
+            # not decided yet (minority acquire delay): re-check next tick
+            self._deadline = time.monotonic() + self.tick_interval
+            return
         for node in decision.down_nodes:
             self.cluster.down(node.address_str)
         if decision.down_nodes and hasattr(self.strategy, "release"):

@@ -32,4 +32,7 @@ __all__ = [
     "EWMA", "AdaptiveLoadBalancingRoutingLogic", "ClusterMetricsExtension",
     "NodeMetrics", "CapacityMetricsSelector", "CpuMetricsSelector",
     "MemoryMetricsSelector", "MixMetricsSelector",
+    "ClusterClient", "ClusterClientReceptionist", "ClusterClientSettings",
 ]
+from .client import (ClusterClient, ClusterClientReceptionist,  # noqa: F401
+                     ClusterClientSettings)

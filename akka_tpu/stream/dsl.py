@@ -1023,6 +1023,111 @@ class RunnableGraph:
         return mat.materialize(self._build)
 
 
+class BidiFlow:
+    """A pair of flows forming a protocol stage: `top` transforms traffic
+    flowing one way (I1 -> O1), `bottom` the other way (I2 -> O2)
+    (reference: scaladsl/BidiFlow.scala — the codec/framing stacking
+    primitive: `codec.atop(framing).join(transport)`)."""
+
+    def __init__(self, top: Flow, bottom: Flow):
+        self.top = top
+        self.bottom = bottom
+
+    @staticmethod
+    def from_flows(top: Flow, bottom: Flow) -> "BidiFlow":
+        return BidiFlow(top, bottom)
+
+    @staticmethod
+    def from_functions(outbound: Callable[[Any], Any],
+                       inbound: Callable[[Any], Any]) -> "BidiFlow":
+        """(reference: BidiFlow.fromFunctions) — map each direction."""
+        return BidiFlow(Flow().map(outbound), Flow().map(inbound))
+
+    def atop(self, other: "BidiFlow") -> "BidiFlow":
+        """Stack `other` below this stage: outbound runs self.top then
+        other.top; inbound runs other.bottom then self.bottom."""
+        return BidiFlow(self.top.via(other.top),
+                        other.bottom.via(self.bottom))
+
+    def reversed(self) -> "BidiFlow":
+        return BidiFlow(self.bottom, self.top)
+
+    def join(self, flow: Flow) -> Flow:
+        """Close the stack over `flow`: I1 -> top -> flow -> bottom -> O2
+        becomes one Flow (the transport at the bottom of a protocol
+        stack — BidiFlow.join)."""
+        return self.top.via(flow).via(self.bottom)
+
+
+class _GraphBuilder:
+    """User-facing graph assembly surface handed to GraphDSL.create's
+    build function (reference: scaladsl/GraphDSL.Builder — add shapes,
+    wire ports explicitly)."""
+
+    def __init__(self, b: _Builder):
+        self._b = b
+
+    # -- adding shapes --------------------------------------------------------
+    def add(self, stage: GraphStage):
+        """Add any GraphStage; returns its logic (ports via .shape)."""
+        logic, _mat = self._b.add(stage)
+        return logic
+
+    def source(self, source: Source) -> Outlet:
+        outlet, _mat = source._build(self._b)
+        return outlet
+
+    def sink(self, sink: Sink, outlet: Outlet) -> Any:
+        """Wire `outlet` into `sink`; returns the sink's mat value."""
+        return sink._build(self._b, outlet)
+
+    def flow(self, outlet: Outlet, flow: Flow) -> Outlet:
+        """Append a linear flow after `outlet`; returns the new outlet."""
+        new_outlet, _mat = flow._build(self._b, outlet)
+        return new_outlet
+
+    def edge(self, outlet: Outlet, inlet: Inlet) -> None:
+        self._b.connect(outlet, inlet)
+
+    # -- junction shorthands --------------------------------------------------
+    def broadcast(self, n: int):
+        return self.add(_ops.BroadcastStage(n))
+
+    def merge(self, n: int):
+        return self.add(_ops.MergeStage(n))
+
+    def balance(self, n: int):
+        return self.add(_ops.BalanceStage(n))
+
+    def concat(self, n: int = 2):
+        return self.add(_ops.ConcatStage(n))
+
+    def zip(self):
+        return self.add(_ops.ZipWithStage(lambda a, b: (a, b)))
+
+
+class GraphDSL:
+    """Arbitrary-graph construction (reference: scaladsl/GraphDSL.create):
+
+        def build(g):
+            bcast = g.broadcast(2)
+            merge = g.merge(2)
+            g.edge(g.source(Source.from_iterable(range(10))),
+                   bcast.shape.in_)
+            g.edge(g.flow(bcast.shape.outs[0], Flow().map(f)),
+                   merge.shape.ins[0])
+            g.edge(g.flow(bcast.shape.outs[1], Flow().map(h)),
+                   merge.shape.ins[1])
+            return g.sink(Sink.seq(), merge.shape.out)
+
+        fut = GraphDSL.create(build).run(system)
+    """
+
+    @staticmethod
+    def create(build_fn: Callable[["_GraphBuilder"], Any]) -> RunnableGraph:
+        return RunnableGraph(lambda b: build_fn(_GraphBuilder(b)))
+
+
 # -- Source gets the whole linear operator library ----------------------------
 # (scaladsl/Source.scala mirrors Flow's operators; delegating through
 # `self.via(Flow().<op>(...))` keeps one implementation per stage)

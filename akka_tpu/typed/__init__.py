@@ -24,3 +24,4 @@ from .receptionist import (Deregister, Deregistered, Find, Listing,  # noqa: F40
                            Subscribe)
 from . import delivery  # noqa: F401
 from .pubsub import Publish, Topic, TopicSubscribe, TopicUnsubscribe  # noqa: F401
+from .routers import Routers  # noqa: F401

@@ -1,0 +1,215 @@
+"""Inventory gap-fills: typed Routers, stream BidiFlow + GraphDSL, and
+ClusterClient (reference: typed/scaladsl/Routers.scala:24,36,
+stream/scaladsl/BidiFlow.scala + GraphDSL.scala,
+cluster-tools client/ClusterClient.scala:287)."""
+
+import time
+
+import pytest
+
+from akka_tpu import ActorSystem as ClassicSystem
+from akka_tpu.stream.dsl import BidiFlow, Flow, GraphDSL, Keep, Sink, Source
+from akka_tpu.testkit import await_condition
+
+
+@pytest.fixture()
+def system():
+    s = ClassicSystem("parity", {"akka": {"stdout-loglevel": "OFF"}})
+    yield s
+    s.terminate()
+    s.await_termination(10)
+
+
+# -- typed Routers ------------------------------------------------------------
+
+def test_typed_pool_router(system):
+    from akka_tpu.typed import Behaviors, Routers
+    from akka_tpu.typed.adapter import props_from_behavior
+
+    seen = []
+
+    def worker():
+        return Behaviors.receive_message(
+            lambda msg: (seen.append(msg), Behaviors.same)[1])
+
+    router = system.actor_of(
+        props_from_behavior(Routers.pool(4, worker)), "pool-router")
+    for i in range(12):
+        router.tell(i)
+    await_condition(lambda: len(seen) == 12, max_time=10.0)
+    assert sorted(seen) == list(range(12))
+
+
+def test_typed_pool_router_with_setup_behavior(system):
+    """Regression (r3 review): Behaviors.setup results define __call__, so
+    a bare callable() check would invoke them argument-less and crash —
+    pool must accept Behavior INSTANCES including deferred ones."""
+    from akka_tpu.typed import Behaviors, Routers
+    from akka_tpu.typed.adapter import props_from_behavior
+
+    seen = []
+
+    def make(ctx):
+        return Behaviors.receive_message(
+            lambda msg: (seen.append(msg), Behaviors.same)[1])
+
+    router = system.actor_of(
+        props_from_behavior(Routers.pool(2, Behaviors.setup(make))),
+        "setup-pool")
+    for i in range(6):
+        router.tell(i)
+    await_condition(lambda: len(seen) == 6, max_time=10.0)
+
+
+def test_typed_pool_router_dead_letters_when_all_routees_gone(system):
+    from akka_tpu.actor.messages import DeadLetter
+    from akka_tpu.typed import Behaviors, Routers
+    from akka_tpu.typed.adapter import props_from_behavior
+
+    dead = []
+    system.event_stream.subscribe(dead.append, DeadLetter)
+
+    def worker():
+        return Behaviors.receive_message(lambda msg: Behaviors.stopped())
+
+    router = system.actor_of(
+        props_from_behavior(Routers.pool(2, worker)), "dying-pool")
+    router.tell("kill-1")
+    router.tell("kill-2")
+    time.sleep(0.4)  # both children stop; Terminated prunes them
+    router.tell("orphan")
+    await_condition(
+        lambda: any(getattr(d, "message", None) == "orphan" for d in dead),
+        max_time=10.0, message="orphan message was silently dropped")
+
+
+def test_typed_group_router(system):
+    from akka_tpu.typed import Behaviors, Receptionist, Routers, ServiceKey
+    from akka_tpu.typed.adapter import props_from_behavior
+
+    key = ServiceKey("group-svc")
+    seen = []
+
+    def svc():
+        return Behaviors.receive_message(
+            lambda msg: (seen.append(msg), Behaviors.same)[1])
+
+    workers = [system.actor_of(props_from_behavior(svc()), f"gsvc-{i}")
+               for i in range(3)]
+    recept = Receptionist.get(system)
+    for w in workers:
+        recept.register(key, w)
+    router = system.actor_of(
+        props_from_behavior(Routers.group(key)), "group-router")
+    for i in range(9):
+        router.tell(i)  # early messages buffer until the Listing arrives
+    await_condition(lambda: len(seen) == 9, max_time=10.0)
+    assert sorted(seen) == list(range(9))
+
+
+# -- BidiFlow -----------------------------------------------------------------
+
+def test_bidiflow_join_protocol_stack(system):
+    # codec (int <-> str) atop framing (str <-> bytes) joined over an
+    # echo transport: the classic protocol-stack shape
+    codec = BidiFlow.from_functions(lambda i: str(i), lambda s: int(s) * 10)
+    framing = BidiFlow.from_functions(lambda s: s.encode(),
+                                      lambda b: b.decode())
+    transport = Flow()  # loopback
+    stack = codec.atop(framing).join(transport)
+    out = Source.from_iterable([1, 2, 3]).via(stack) \
+        .run_with(Sink.seq(), system).result(10.0)
+    assert out == [10, 20, 30]
+
+
+def test_bidiflow_reversed(system):
+    bidi = BidiFlow.from_functions(lambda x: x + 1, lambda x: x * 2)
+    rev = bidi.reversed()
+    out = Source.from_iterable([1, 2]).via(rev.join(Flow())) \
+        .run_with(Sink.seq(), system).result(10.0)
+    assert out == [3, 5]  # *2 then +1
+
+
+# -- GraphDSL -----------------------------------------------------------------
+
+def test_graphdsl_diamond(system):
+    def build(g):
+        bcast = g.broadcast(2)
+        merge = g.merge(2)
+        g.edge(g.source(Source.from_iterable(range(10))),
+               bcast.shape.in_)
+        g.edge(g.flow(bcast.shape.outs[0], Flow().map(lambda x: x * 10)),
+               merge.shape.ins[0])
+        g.edge(g.flow(bcast.shape.outs[1], Flow().map(lambda x: x + 1000)),
+               merge.shape.ins[1])
+        return g.sink(Sink.seq(), merge.shape.out)
+
+    out = GraphDSL.create(build).run(system).result(10.0)
+    assert sorted(out) == sorted(
+        [x * 10 for x in range(10)] + [x + 1000 for x in range(10)])
+
+
+def test_graphdsl_zip_two_sources(system):
+    def build(g):
+        z = g.zip()
+        g.edge(g.source(Source.from_iterable("abc")), z.shape.ins[0])
+        g.edge(g.source(Source.from_iterable(range(3))), z.shape.ins[1])
+        return g.sink(Sink.seq(), z.shape.out)
+
+    out = GraphDSL.create(build).run(system).result(10.0)
+    assert out == [("a", 0), ("b", 1), ("c", 2)]
+
+
+# -- ClusterClient ------------------------------------------------------------
+
+def test_cluster_client_roundtrip():
+    from akka_tpu import Actor, Props, ask_sync
+    from akka_tpu.cluster import Cluster
+    from akka_tpu.cluster_tools import (ClusterClient,
+                                        ClusterClientReceptionist,
+                                        ClusterClientSettings)
+    from akka_tpu.cluster_tools.client import Publish, Send, SendToAll
+    from akka_tpu.remote.transport import InProcTransport
+
+    InProcTransport.fault_injector.reset()
+    cluster_sys = ClassicSystem.create("ccsrv", {
+        "akka": {"actor": {"provider": "cluster"},
+                 "stdout-loglevel": "OFF", "log-dead-letters": 0,
+                 "remote": {"transport": "inproc",
+                            "canonical": {"hostname": "local", "port": 0}},
+                 "cluster": {"gossip-interval": "0.05s",
+                             "leader-actions-interval": "0.05s"}}})
+    client_sys = ClassicSystem.create("ccext", {
+        "akka": {"actor": {"provider": "remote"},
+                 "stdout-loglevel": "OFF", "log-dead-letters": 0,
+                 "remote": {"transport": "inproc",
+                            "canonical": {"hostname": "local", "port": 0}}}})
+    try:
+        Cluster.get(cluster_sys).join(
+            str(cluster_sys.provider.local_address))
+
+        class Service(Actor):
+            def receive(self, message):
+                self.sender.tell(("served", message), self.self_ref)
+
+        svc = cluster_sys.actor_of(Props.create(Service), "the-service")
+        recept = ClusterClientReceptionist.get(cluster_sys)
+        recept.register_service(svc)
+
+        contact = str(cluster_sys.provider.local_address)
+        client = client_sys.actor_of(Props.create(
+            ClusterClient,
+            ClusterClientSettings(initial_contacts=(contact,))), "client")
+        # messages sent BEFORE establishment buffer and then flow
+        got = ask_sync(client, Send("/user/the-service", "hello"),
+                       timeout=10.0, system=client_sys)
+        assert got == ("served", "hello")
+        got = ask_sync(client, SendToAll("/user/the-service", "all"),
+                       timeout=10.0, system=client_sys)
+        assert got == ("served", "all")
+    finally:
+        for s in (client_sys, cluster_sys):
+            s.terminate()
+        for s in (client_sys, cluster_sys):
+            s.await_termination(10.0)
+        InProcTransport.fault_injector.reset()
