@@ -222,6 +222,15 @@ class SplitBrainResolver(Actor):
         if self._task is not None:
             self._task.cancel()
 
+    def _reset_strategy(self) -> None:
+        """Any reachability change restarts the stability window — stateful
+        strategies (lease-majority's minority acquire delay) must restart
+        their episode state WITH it, or a flap mid-delay would let the
+        delay expire unobserved and reinstate the symmetric lease race."""
+        reset = getattr(self.strategy, "reset", None)
+        if reset is not None:
+            reset()
+
     def receive(self, message: Any):
         if isinstance(message, UnreachableMember):
             # SBR is PER-DC (the reference's SBR only acts within its own
@@ -232,16 +241,12 @@ class SplitBrainResolver(Actor):
                 return None
             self._unreachable.add(message.member.unique_address)
             self._deadline = time.monotonic() + self.stable_after
+            self._reset_strategy()
         elif isinstance(message, ReachableMember):
             self._unreachable.discard(message.member.unique_address)
             self._deadline = (time.monotonic() + self.stable_after
                               if self._unreachable else None)
-            if not self._unreachable:
-                # episode over with no decision: let stateful strategies
-                # (lease-majority's minority delay) start fresh next time
-                reset = getattr(self.strategy, "reset", None)
-                if reset is not None:
-                    reset()
+            self._reset_strategy()
         elif isinstance(message, self._Tick):
             if (self._deadline is not None and self._unreachable
                     and time.monotonic() >= self._deadline):

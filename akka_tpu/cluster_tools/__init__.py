@@ -14,6 +14,7 @@ from .pubsub import (DistributedPubSub, DistributedPubSubMediator, Publish,
                      Unsubscribe, UnsubscribeAck, GetTopics, CurrentTopics)
 from .lease import Lease, LeaseProvider, LeaseSettings, InProcLease, TimeoutSettings
 from .discovery import (AggregateServiceDiscovery, ConfigServiceDiscovery,
+                        DnsServiceDiscovery,
                         Discovery, Lookup, Resolved, ResolvedTarget,
                         ServiceDiscovery)
 from .metrics import (EWMA, AdaptiveLoadBalancingRoutingLogic,
@@ -27,7 +28,8 @@ __all__ = [
     "Send", "SendToAll", "Subscribe", "SubscribeAck", "Unsubscribe",
     "UnsubscribeAck", "GetTopics", "CurrentTopics",
     "Lease", "LeaseProvider", "LeaseSettings", "InProcLease", "TimeoutSettings",
-    "AggregateServiceDiscovery", "ConfigServiceDiscovery", "Discovery", "Lookup",
+    "AggregateServiceDiscovery", "ConfigServiceDiscovery", "DnsServiceDiscovery",
+    "Discovery", "Lookup",
     "Resolved", "ResolvedTarget", "ServiceDiscovery",
     "EWMA", "AdaptiveLoadBalancingRoutingLogic", "ClusterMetricsExtension",
     "NodeMetrics", "CapacityMetricsSelector", "CpuMetricsSelector",

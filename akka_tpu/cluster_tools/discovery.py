@@ -3,9 +3,9 @@
 Reference parity: akka-discovery/src/main/scala/akka/discovery/
 ServiceDiscovery.scala (Lookup/Resolved/ResolvedTarget), impls
 config/ConfigServiceDiscovery.scala (:51), aggregate/AggregateServiceDiscovery
-(:49 — try methods in order until one returns targets). The DNS impl is
-replaced by an in-proc registry (zero-egress environment); the seam is the
-same method-name registry keyed from config.
+(:49 — try methods in order until one returns targets), and a DNS method
+(dns/DnsServiceDiscovery.scala:69) via the system resolver; the in-proc
+registry stands in for DNS in zero-egress multi-'node' tests.
 """
 
 from __future__ import annotations
@@ -93,6 +93,56 @@ class InProcServiceDiscovery(ServiceDiscovery):
                 InProcServiceDiscovery._registry.get(lookup.service_name, ())))
 
 
+class DnsServiceDiscovery(ServiceDiscovery):
+    """Resolve service names through DNS (reference:
+    discovery/dns/DnsServiceDiscovery.scala:69 — the reference speaks
+    SRV + A records through the async resolver; here A/AAAA via the
+    system resolver, with the Lookup's port_name carried onto every
+    target when it parses as a port number, matching how the A-record
+    mode of the reference leaves ports to configuration)."""
+
+    def __init__(self, system: Optional[ActorSystem] = None):
+        pass
+
+    _pool = None
+    _pool_lock = threading.Lock()
+
+    @classmethod
+    def _executor(cls):
+        if cls._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            with cls._pool_lock:
+                if cls._pool is None:
+                    cls._pool = ThreadPoolExecutor(
+                        max_workers=4, thread_name_prefix="akka-tpu-dns")
+        return cls._pool
+
+    def lookup(self, lookup: Lookup, resolve_timeout: float = 3.0) -> Resolved:
+        import socket
+        from concurrent.futures import TimeoutError as _FutTimeout
+
+        port: Optional[int] = None
+        if lookup.port_name and lookup.port_name.isdigit():
+            port = int(lookup.port_name)
+        # getaddrinfo has no timeout of its own (OS resolver retries can
+        # block 5-30s) — honor the advertised resolve_timeout by resolving
+        # on a worker thread and abandoning the wait
+        fut = self._executor().submit(
+            socket.getaddrinfo, lookup.service_name, port,
+            type=socket.SOCK_STREAM)
+        try:
+            infos = fut.result(timeout=resolve_timeout)
+        except (OSError, _FutTimeout):
+            fut.cancel()
+            return Resolved(lookup.service_name)
+        seen = []
+        for _family, _t, _p, _canon, sockaddr in infos:
+            target = ResolvedTarget(sockaddr[0], port)
+            if target not in seen:
+                seen.append(target)
+        return Resolved(lookup.service_name, tuple(seen))
+
+
 class AggregateServiceDiscovery(ServiceDiscovery):
     """Try each method in order; first non-empty wins
     (reference: aggregate/AggregateServiceDiscovery.scala:49)."""
@@ -112,6 +162,7 @@ class AggregateServiceDiscovery(ServiceDiscovery):
 _METHODS: Dict[str, Callable[[ActorSystem], ServiceDiscovery]] = {
     "config": ConfigServiceDiscovery,
     "in-proc": InProcServiceDiscovery,
+    "dns": DnsServiceDiscovery,
 }
 
 

@@ -14,7 +14,8 @@ from .stage import (FanInShape, FanOutShape, FlowShape, GraphStage,  # noqa: F40
                     make_out_handler)
 from .interpreter import (ActorGraphInterpreter, Connection,  # noqa: F401
                           GraphInterpreter, IllegalStateException)
-from .dsl import Flow, Keep, Materializer, RunnableGraph, Sink, Source  # noqa: F401
+from .dsl import (BidiFlow, Flow, GraphDSL, Keep, Materializer,  # noqa: F401
+                  RunnableGraph, Sink, Source)
 from .ops import (BufferOverflowException, NoSuchElementException,  # noqa: F401
                   SinkQueue, SourceQueue, TickCancellable)
 from .killswitch import (KillSwitches, SharedKillSwitch,  # noqa: F401
@@ -26,6 +27,7 @@ from .ops import _QUEUE_END as QUEUE_END  # noqa: F401
 
 __all__ = [
     "Source", "Flow", "Sink", "Keep", "RunnableGraph", "Materializer",
+    "BidiFlow", "GraphDSL",
     "GraphStage", "GraphStageLogic", "InHandler", "OutHandler",
     "Inlet", "Outlet", "Shape", "SourceShape", "SinkShape", "FlowShape",
     "FanInShape", "FanOutShape", "make_in_handler", "make_out_handler",

@@ -71,9 +71,11 @@ class Routers:
 
             def on_signal(ctx_, sig):
                 from ..actor.messages import Terminated as _T
-                actor = getattr(sig, "actor", None) or getattr(sig, "ref", None)
-                if actor is not None:
-                    routees[:] = [r for r in routees if r != actor]
+                if isinstance(sig, _T):
+                    actor = getattr(sig, "actor", None) or \
+                        getattr(sig, "ref", None)
+                    if actor is not None:
+                        routees[:] = [r for r in routees if r != actor]
                 return Behaviors.same
 
             return Behaviors.receive(on_message, on_signal)

@@ -219,6 +219,26 @@ def test_config_service_discovery():
         assert d.lookup(Lookup("nope")).addresses == ()
 
 
+def test_dns_service_discovery():
+    """DNS method (reference: dns/DnsServiceDiscovery.scala:69) resolves
+    through the system resolver; misses yield an empty Resolved."""
+    from akka_tpu.cluster_tools import DnsServiceDiscovery
+    d = DnsServiceDiscovery()
+    res = d.lookup(Lookup("localhost", port_name="9090"))
+    assert res.addresses and all(t.port == 9090 for t in res.addresses)
+    assert "127.0.0.1" in {t.host for t in res.addresses} or \
+        "::1" in {t.host for t in res.addresses}
+    assert d.lookup(Lookup("no-such-host.invalid")).addresses == ()
+
+
+def test_dns_method_selectable_from_config():
+    cfg = {"akka": {"stdout-loglevel": "OFF",
+                    "discovery": {"method": "dns"}}}
+    with ActorSystem.create("discdns", cfg) as sys_:
+        from akka_tpu.cluster_tools import Discovery, DnsServiceDiscovery
+        assert isinstance(Discovery.get(sys_).discovery, DnsServiceDiscovery)
+
+
 # -- metrics -----------------------------------------------------------------
 
 def test_ewma_decays_toward_new_value():
