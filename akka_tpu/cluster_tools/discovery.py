@@ -104,37 +104,35 @@ class DnsServiceDiscovery(ServiceDiscovery):
     def __init__(self, system: Optional[ActorSystem] = None):
         pass
 
-    _pool = None
-    _pool_lock = threading.Lock()
-
-    @classmethod
-    def _executor(cls):
-        if cls._pool is None:
-            from concurrent.futures import ThreadPoolExecutor
-            with cls._pool_lock:
-                if cls._pool is None:
-                    cls._pool = ThreadPoolExecutor(
-                        max_workers=4, thread_name_prefix="akka-tpu-dns")
-        return cls._pool
-
     def lookup(self, lookup: Lookup, resolve_timeout: float = 3.0) -> Resolved:
         import socket
-        from concurrent.futures import TimeoutError as _FutTimeout
 
         port: Optional[int] = None
         if lookup.port_name and lookup.port_name.isdigit():
             port = int(lookup.port_name)
         # getaddrinfo has no timeout of its own (OS resolver retries can
         # block 5-30s) — honor the advertised resolve_timeout by resolving
-        # on a worker thread and abandoning the wait
-        fut = self._executor().submit(
-            socket.getaddrinfo, lookup.service_name, port,
-            type=socket.SOCK_STREAM)
-        try:
-            infos = fut.result(timeout=resolve_timeout)
-        except (OSError, _FutTimeout):
-            fut.cancel()
+        # on a PER-CALL daemon thread and abandoning the wait. A fixed pool
+        # would let a few black-holed resolutions starve every later lookup
+        # (a running getaddrinfo cannot be cancelled); an abandoned thread
+        # costs one stack until the OS resolver gives up, bounded by its
+        # own retry window.
+        result: Dict[str, object] = {}
+        done = threading.Event()
+
+        def work():
+            try:
+                result["v"] = socket.getaddrinfo(
+                    lookup.service_name, port, type=socket.SOCK_STREAM)
+            except OSError:
+                pass
+            done.set()
+
+        threading.Thread(target=work, daemon=True,
+                         name="akka-tpu-dns").start()
+        if not done.wait(resolve_timeout) or "v" not in result:
             return Resolved(lookup.service_name)
+        infos = result["v"]
         seen = []
         for _family, _t, _p, _canon, sockaddr in infos:
             target = ResolvedTarget(sockaddr[0], port)

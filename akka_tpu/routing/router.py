@@ -297,6 +297,67 @@ class DefaultResizer:
         return new_cap - cap
 
 
+@dataclass
+class OptimalSizeExploringResizer:
+    """Explore-and-exploit pool sizing (reference:
+    routing/OptimalSizeExploringResizer.scala): most resize checks EXPLOIT
+    the best-throughput size seen so far; with `explore_step_size`
+    probability-driven jitter the pool EXPLORES nearby sizes, recording
+    messages-processed-per-size so the optimum tracks changing workloads.
+    Same `resize(routees) -> delta` seam as DefaultResizer."""
+
+    lower_bound: int = 1
+    upper_bound: int = 10
+    chance_of_exploration: float = 0.4
+    explore_step_size: float = 0.1
+    messages_per_resize: int = 10
+    # decayed throughput record: size -> (ewma msgs processed per check)
+    _perf: dict = field(default_factory=dict)
+    _last_queued: int = 0
+
+    def is_time_for_resize(self, message_counter: int) -> bool:
+        return message_counter % self.messages_per_resize == 0
+
+    def _record(self, routees: Sequence[Routee]) -> int:
+        """Messages PROCESSED since the last check: exactly
+        messages_per_resize were routed between checks, so processed =
+        routed - backlog growth. Backlog is tracked as a delta (not an
+        absolute clamp) so sizes stay distinguishable under sustained
+        saturation — a size that drains faster records more throughput
+        even while a queue persists."""
+        queued = 0
+        for r in routees:
+            cell = getattr(getattr(r, "ref", None), "cell", None)
+            if cell is not None and cell.mailbox is not None:
+                queued += cell.mailbox.number_of_messages
+        processed = max(
+            0, self.messages_per_resize - (queued - self._last_queued))
+        self._last_queued = queued
+        size = len(routees)
+        prev = self._perf.get(size)
+        self._perf[size] = (processed if prev is None
+                            else 0.5 * prev + 0.5 * processed)
+        return queued
+
+    def resize(self, routees: Sequence[Routee]) -> int:
+        size = len(routees)
+        queued = self._record(routees)
+        if _random.random() < self.chance_of_exploration:
+            # explore: jitter around the current size
+            step = max(1, int(size * self.explore_step_size))
+            target = size + _random.choice((-step, step))
+        else:
+            # exploit: the best recorded size; bias upward under pressure
+            if self._perf:
+                target = max(self._perf.items(), key=lambda kv: kv[1])[0]
+            else:
+                target = size
+            if queued > size:
+                target = max(target, size + 1)
+        target = min(max(target, self.lower_bound), self.upper_bound)
+        return target - size
+
+
 # -- router configs ----------------------------------------------------------
 
 @dataclass(frozen=True)

@@ -803,6 +803,73 @@ class Flow:
     def on_error_complete(self, pred=None) -> "Flow":
         return self._append(lambda: _ops3.OnErrorComplete(pred))
 
+    def also_to_all(self, *sinks: "Sink") -> "Flow":
+        """also_to chained over every sink (scaladsl alsoToAll)."""
+        flow = self
+        for s in sinks:
+            flow = flow.also_to(s)
+        return flow
+
+    def merge_all(self, sources) -> "Flow":
+        """Merge every source into this flow (scaladsl mergeAll)."""
+        flow = self
+        for src in sources:
+            flow = flow.merge(src)
+        return flow
+
+    def interleave_all(self, sources, segment_size: int) -> "Flow":
+        """Round-robin interleave across this flow AND every source in ONE
+        N-way stage (scaladsl interleaveAll) — chaining 2-way interleaves
+        would scramble the round-robin order across sources."""
+        sources = list(sources)
+        prev = self._build
+        builds = [s._build for s in sources]
+
+        def build(b: _Builder, upstream: Outlet):
+            o1, m1 = prev(b, upstream)
+            logic, _l = b.add(_ops.InterleaveStage(segment_size,
+                                                   n=1 + len(builds)))
+            b.connect(o1, logic.shape.ins[0])
+            for i, sb in enumerate(builds):
+                oi, _mi = sb(b)
+                b.connect(oi, logic.shape.ins[1 + i])
+            return logic.shape.out, m1
+        return Flow(build)
+
+    def concat_all_lazy(self, *sources: Source) -> "Flow":
+        """Concat every source after this flow's elements, each materialized
+        only when reached (scaladsl concatAllLazy — our ConcatStage pulls
+        an input only once it becomes active)."""
+        flow = self
+        for src in sources:
+            flow = flow.concat(src)
+        return flow
+
+    def collect_type(self, cls) -> "Flow":
+        """Pass through only instances of `cls` (scaladsl collectType)."""
+        return self.collect(lambda x: x if isinstance(x, cls) else None)
+
+    def flat_map_prefix(self, n: int, fn) -> "Flow":
+        """Consume the first n elements, then run the REST of the stream
+        through the Flow `fn(prefix)` returns (scaladsl flatMapPrefix) —
+        composed from prefix_and_tail + flat_map_concat."""
+        return self.prefix_and_tail(n).flat_map_concat(
+            lambda pt: pt[1].via(fn(pt[0])))
+
+    def extrapolate(self, extrapolator, initial=None) -> "Flow":
+        """Meet faster downstream demand by extrapolating from the last
+        element (scaladsl extrapolate, an expand specialization: the
+        element itself is emitted first, then extrapolations)."""
+        def expander(elem):
+            def gen():
+                yield elem
+                yield from extrapolator(elem)
+            return gen()
+        flow = self.expand(expander)
+        if initial is not None:
+            flow = flow.prepend(Source.single(initial))
+        return flow
+
     def async_(self) -> "Flow":
         """Mark an ASYNC BOUNDARY: stages after this point run in their own
         island (one interpreter actor per island), with backpressure across
@@ -1146,7 +1213,9 @@ _SOURCE_MIRRORED_OPS = [
     "recover_with_retries", "watch_termination",
     "zip_latest", "zip_latest_with", "zip_all", "merge_sorted",
     "merge_prioritized", "divert_to", "fold_async", "scan_async",
-    "on_error_complete", "async_",
+    "on_error_complete", "async_", "also_to_all", "merge_all",
+    "interleave_all", "concat_all_lazy", "collect_type",
+    "flat_map_prefix", "extrapolate",
 ]
 
 

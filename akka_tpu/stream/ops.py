@@ -1536,10 +1536,15 @@ class ZipWithStage(GraphStage):
 
 
 class InterleaveStage(GraphStage):
-    def __init__(self, segment_size: int):
+    """N-way round-robin interleave: `segment` elements from each input in
+    turn (reference Interleave supports any input count — interleaveAll
+    must yield round-robin order ACROSS all sources, which chained 2-way
+    interleaves would not)."""
+
+    def __init__(self, segment_size: int, n: int = 2):
         self.name = "Interleave"
         self.segment = max(1, segment_size)
-        self.ins = [Inlet("Ilv.in0"), Inlet("Ilv.in1")]
+        self.ins = [Inlet(f"Ilv.in{i}") for i in range(n)]
         self.out = Outlet("Ilv.out")
         self._shape = FanInShape(self.ins, self.out)
 
@@ -1554,9 +1559,11 @@ class InterleaveStage(GraphStage):
 
         def switch():
             state["count"] = 0
-            other = 1 - state["cur"]
-            if not logic.is_closed(ins[other]):
-                state["cur"] = other
+            for step in range(1, len(ins) + 1):
+                nxt = (state["cur"] + step) % len(ins)
+                if not logic.is_closed(ins[nxt]):
+                    state["cur"] = nxt
+                    return
 
         def mk_push(i, inlet):
             def on_push():

@@ -244,6 +244,90 @@ def test_async_boundary_error_crosses_islands(system):
     assert isinstance(fut.exception(10.0), ValueError)
 
 
+def test_composition_operator_batch(system):
+    """alsoToAll / mergeAll / interleaveAll / concatAllLazy / collectType /
+    flatMapPrefix / extrapolate (scaladsl Flow.scala parity batch)."""
+    # also_to_all: every sink sees every element
+    futs = {}
+
+    def capture(name):
+        inner = Sink.seq()
+
+        def build(b, upstream):
+            futs[name] = inner._build(b, upstream)
+            return futs[name]
+        return Sink(build)
+
+    out = Source.from_iterable(range(4)) \
+        .also_to_all(capture("a"), capture("b")) \
+        .run_with(Sink.seq(), system).result(10.0)
+    assert out == [0, 1, 2, 3]
+    assert futs["a"].result(5.0) == futs["b"].result(5.0) == [0, 1, 2, 3]
+
+    # merge_all / concat_all_lazy
+    out = run_seq(Source.from_iterable([1]).merge_all(
+        [Source.from_iterable([2]), Source.from_iterable([3])]), system)
+    assert sorted(out) == [1, 2, 3]
+    out = run_seq(Source.from_iterable([1]).concat_all_lazy(
+        Source.from_iterable([2]), Source.from_iterable([3])), system)
+    assert out == [1, 2, 3]
+
+    # interleave_all: EXACT round-robin order across ALL sources (r3
+    # review: chained 2-way interleaves would scramble this)
+    out = run_seq(Source.from_iterable([1, 4]).interleave_all(
+        [Source.from_iterable([2, 5]), Source.from_iterable([3, 6])], 1),
+        system)
+    assert out == [1, 2, 3, 4, 5, 6]
+
+    # collect_type
+    out = run_seq(Source.from_iterable([1, "a", 2.5, "b", 3])
+                  .collect_type(str), system)
+    assert out == ["a", "b"]
+
+    # flat_map_prefix: the prefix CONFIGURES the rest of the stream
+    out = run_seq(
+        Source.from_iterable([10, 1, 2, 3]).flat_map_prefix(
+            1, lambda prefix: Flow().map(lambda x: x * prefix[0])),
+        system)
+    assert out == [10, 20, 30]
+
+    # extrapolate: an OPEN-but-idle upstream + eager downstream gets the
+    # element then extrapolations (a completed upstream ends the stream,
+    # as in the reference)
+    queue, fut = Source.queue(8).extrapolate(
+        lambda e: iter([e + 1, e + 2])).take(3) \
+        .to_mat(Sink.seq(), lambda l, r: (l, r)).run(system)
+    queue.offer(5)
+    assert fut.result(10.0) == [5, 6, 7]
+    queue.complete()
+
+
+def test_optimal_size_exploring_resizer():
+    """Explore/exploit pool sizing (routing/OptimalSizeExploringResizer.scala
+    parity): stays within bounds, explores off the current size, and
+    exploits the best recorded size."""
+    from akka_tpu.routing.router import OptimalSizeExploringResizer
+
+    class FakeRoutee:
+        class ref:
+            class cell:
+                class mailbox:
+                    number_of_messages = 0
+
+    r = OptimalSizeExploringResizer(lower_bound=2, upper_bound=8,
+                                    chance_of_exploration=1.0)
+    routees = [FakeRoutee()] * 4
+    for _ in range(50):
+        delta = r.resize(routees)
+        assert 2 <= 4 + delta <= 8  # always within bounds
+    # pure exploitation converges on the best recorded size
+    r2 = OptimalSizeExploringResizer(lower_bound=1, upper_bound=10,
+                                     chance_of_exploration=0.0)
+    r2._perf = {3: 10.0, 5: 50.0, 7: 20.0}
+    assert 4 + r2.resize(routees) == 5
+    assert r2.is_time_for_resize(10) and not r2.is_time_for_resize(11)
+
+
 def test_flow_level_fan_ins(system):
     out = run_seq(
         Source.from_iterable([1, 2]).via(
