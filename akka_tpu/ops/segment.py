@@ -44,10 +44,17 @@ def deliver(dst: jax.Array, payload: jax.Array, valid: jax.Array,
       N-shaped); pathological for large unsorted M on TPU.
     - "sort":    sort + searchsorted + cumsum-gathers (the original
       reference implementation; CPU-friendly, gather-heavy on TPU).
-    - "auto":    scatter for tiny M, merge otherwise.
+    - "auto":    platform-aware (decided at trace time, so it is free at
+      runtime): scatter for tiny M; scatter on CPU backends, where XLA's
+      scatter-add lowers to a serial loop that still beats two full
+      multi-operand sorts by ~70x (bench.py modes, r4); merge on TPU,
+      where sorts vectorize and unsorted scatters serialize.
     """
     if mode == "auto":
-        mode = "scatter" if dst.shape[0] <= 1024 else "merge"
+        if dst.shape[0] <= 1024 or jax.default_backend() == "cpu":
+            mode = "scatter"
+        else:
+            mode = "merge"
     if mode == "merge":
         return _deliver_merge(dst, payload, valid, n_actors, need_max)
     if mode == "sort":
