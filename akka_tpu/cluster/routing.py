@@ -147,8 +147,11 @@ class ClusterRouterActor(RouterActor):
             return False
         # never (re)deploy onto a node currently marked unreachable — the
         # reference's availableNodes excludes them; without this, the
-        # backfill after _remove_node would put routees straight back
-        if member in self.cluster.state.unreachable:
+        # backfill after _remove_node would put routees straight back.
+        # Compare by unique_address: an event-snapshot Member can differ
+        # from the gossip snapshot in status/up_number (ADVICE r3)
+        if member.unique_address in {m.unique_address
+                                     for m in self.cluster.state.unreachable}:
             return False
         roles = frozenset(self.settings.use_roles)
         if roles and not roles.issubset(member.roles):

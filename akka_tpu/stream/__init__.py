@@ -23,6 +23,9 @@ from .killswitch import (KillSwitches, SharedKillSwitch,  # noqa: F401
 from .hub import BroadcastHub, MergeHub  # noqa: F401
 from .device import DevicePipeline  # noqa: F401
 from .streamref import SinkRef, SourceRef, StreamRefs  # noqa: F401
+from .attributes import Attributes, Supervision  # noqa: F401
+from .restart import (RestartFlow, RestartSettings, RestartSink,  # noqa: F401
+                      RestartSource)
 from .ops import _QUEUE_END as QUEUE_END  # noqa: F401
 
 __all__ = [
@@ -38,4 +41,6 @@ __all__ = [
     "KillSwitches", "UniqueKillSwitch", "SharedKillSwitch",
     "MergeHub", "BroadcastHub", "DevicePipeline",
     "StreamRefs", "SourceRef", "SinkRef",
+    "Attributes", "Supervision",
+    "RestartSource", "RestartFlow", "RestartSink", "RestartSettings",
 ]

@@ -68,9 +68,14 @@ class _Builder:
         self.edges: List[Tuple[Outlet, Inlet]] = []
         self.current_island = 0
         self.island_of: Dict[int, int] = {}  # id(logic) -> island
+        # the with_attributes section currently being built; stamped onto
+        # every stage added inside it (Attributes.scala section scoping)
+        self.current_attributes = None
 
     def add(self, stage: GraphStage) -> Tuple[GraphStageLogic, Any]:
         logic, mat = stage.create_logic_and_mat()
+        if self.current_attributes is not None and logic.attributes is None:
+            logic.attributes = self.current_attributes
         self.logics.append(logic)
         self.island_of[id(logic)] = self.current_island
         for p in logic.shape.inlets:
@@ -178,13 +183,17 @@ class _ChannelSink(GraphStageLogic):
 
 class _ChannelSource(GraphStageLogic):
     """Downstream-island end of an async boundary (input boundary):
-    buffers up to a batch of elements and keeps demand outstanding."""
+    buffers up to a batch of elements and keeps demand outstanding. The
+    batch size is the downstream stage's Attributes.input_buffer max (the
+    reference's InputBuffer attribute sizes exactly this boundary buffer,
+    BatchingActorInputBoundary)."""
 
-    def __init__(self, channel: _IslandChannel):
+    def __init__(self, channel: _IslandChannel, batch: int = _CHANNEL_BATCH):
         out = Outlet("Island.out")
         super().__init__(SourceShape(out))
         self.out = out
         self.channel = channel
+        self.batch = max(int(batch), 1)
         self.buf = collections.deque()
         self.outstanding = 0
         self.done = False
@@ -199,8 +208,8 @@ class _ChannelSource(GraphStageLogic):
 
     def pre_start(self):
         self.channel.source_started()
-        self.outstanding = _CHANNEL_BATCH
-        self.channel.to_sink(("demand", _CHANNEL_BATCH))
+        self.outstanding = self.batch
+        self.channel.to_sink(("demand", self.batch))
 
     def _pump(self):
         if self.failure is not None:
@@ -211,8 +220,8 @@ class _ChannelSource(GraphStageLogic):
         if self.done and not self.buf:
             self.complete(self.out)
             return
-        want = _CHANNEL_BATCH - len(self.buf) - self.outstanding
-        if want >= _CHANNEL_BATCH // 2 and not self.done:
+        want = self.batch - len(self.buf) - self.outstanding
+        if want >= max(self.batch // 2, 1) and not self.done:
             self.outstanding += want
             self.channel.to_sink(("demand", want))
 
@@ -243,6 +252,21 @@ class Materializer:
     def __init__(self, system):
         self.system = system
 
+    @staticmethod
+    def _island_props(interp, logics) -> "Props":
+        """Island actor Props, honoring ActorAttributes.dispatcher: the
+        first stage in the island that names one selects the dispatcher
+        its interpreter runs on (reference: PhasedFusingActorMaterializer
+        resolving Attributes.dispatcher per island)."""
+        props = Props.create(ActorGraphInterpreter, interp)
+        for lg in logics:
+            attrs = getattr(lg, "attributes", None)
+            if attrs is not None:
+                d = attrs.get("dispatcher")
+                if d:
+                    return props.with_dispatcher(d)
+        return props
+
     def materialize(self, build: Callable[[_Builder], Any]) -> Any:
         b = _Builder(self)
         mat = build(b)
@@ -257,8 +281,7 @@ class Materializer:
             interp = GraphInterpreter(b.logics, connections,
                                       materializer=self)
             self.system.actor_of(
-                Props.create(ActorGraphInterpreter, interp),
-                f"stream-{run_id}")
+                self._island_props(interp, b.logics), f"stream-{run_id}")
             return mat
 
         # multi-island: split edges at boundaries
@@ -276,7 +299,14 @@ class Materializer:
             else:
                 ch = _IslandChannel()
                 snk = _ChannelSink(ch)
-                src = _ChannelSource(ch)
+                # boundary buffer sized by the downstream stage's
+                # Attributes.input_buffer (max), the reference's InputBuffer
+                in_logic = b.logic_by_port[inlet.id]
+                attrs = getattr(in_logic, "attributes", None)
+                batch = attrs.effective_input_buffer(
+                    (_CHANNEL_BATCH, _CHANNEL_BATCH))[1] \
+                    if attrs is not None else _CHANNEL_BATCH
+                src = _ChannelSource(ch, batch=batch)
                 by_island[out_isl].append(snk)
                 by_island[in_isl].append(src)
                 island_edges[out_isl].append((outlet, snk.in_))
@@ -295,7 +325,7 @@ class Materializer:
             interp = GraphInterpreter(by_island[isl], connections,
                                       materializer=self)
             self.system.actor_of(
-                Props.create(ActorGraphInterpreter, interp),
+                self._island_props(interp, by_island[isl]),
                 f"stream-{run_id}-island-{isl}")
         return mat
 
@@ -389,22 +419,13 @@ class Source:
                         read: Callable[[Any], Optional[Any]],
                         close: Callable[[Any], None]) -> "Source":
         """Open a resource per materialization, emit read() values until it
-        returns None, close on completion/failure (Source.unfoldResource)."""
-        def gen():
-            resource = create()
-            try:
-                while True:
-                    v = read(resource)
-                    if v is None:
-                        return
-                    yield v
-            finally:
-                close(resource)
-
-        class _PerRun:
-            def __iter__(self):
-                return gen()
-        return Source.from_graph(lambda: _ops.IterableSource(_PerRun()))
+        returns None, close on EVERY termination path — exhaustion, failure,
+        AND downstream cancel (Source.unfoldResource; a real stage whose
+        post_stop closes, not a generator finally that waited for GC —
+        ADVICE r3)."""
+        from .ops3 import UnfoldResourceSource
+        return Source.from_graph(
+            lambda: UnfoldResourceSource(create, read, close))
 
     @staticmethod
     def actor_ref(buffer_size: int = 256) -> "Source":
@@ -526,6 +547,19 @@ class Source:
     def wire_tap(self, fn: Callable[[Any], None]) -> "Source":
         return self.via(Flow().wire_tap(fn))
 
+    # -- attributes -----------------------------------------------------------
+    def with_attributes(self, attrs) -> "Source":
+        """Attach Attributes to every stage this Source has built SO FAR
+        (section scoping: operators appended after this call are outside —
+        Attributes.scala:662; supervision deciders are the headline use)."""
+        return Source(_scoped_attributes(self._build, attrs))
+
+    add_attributes = with_attributes
+
+    def named(self, name: str) -> "Source":
+        from .attributes import Attributes
+        return self.with_attributes(Attributes.name(name))
+
     # -- run ------------------------------------------------------------------
     def run(self, materializer_or_system) -> Any:
         return self.to(Sink.ignore(), Keep.left).run(materializer_or_system)
@@ -547,6 +581,21 @@ def _linear(op_factory: Callable[[], GraphStage]):
         b.connect(upstream, logic.shape.in_)
         return logic.shape.out, mat
     return flow_build
+
+
+def _scoped_attributes(prev_build, attrs):
+    """Wrap a build so stages created inside it carry `attrs` layered over
+    any enclosing section's attributes (innermost wins — the reference's
+    `and` composition order)."""
+    def build(b: _Builder, *args):
+        saved = b.current_attributes
+        b.current_attributes = attrs if saved is None \
+            else saved.and_then(attrs)
+        try:
+            return prev_build(b, *args)
+        finally:
+            b.current_attributes = saved
+    return build
 
 
 class Flow:
@@ -602,6 +651,18 @@ class Flow:
         return Sink(build)
 
     to_mat = to
+
+    # -- attributes -----------------------------------------------------------
+    def with_attributes(self, attrs) -> "Flow":
+        """Attach Attributes to every stage this Flow has built so far
+        (Attributes.scala:662 section scoping)."""
+        return Flow(_scoped_attributes(self._build, attrs))
+
+    add_attributes = with_attributes
+
+    def named(self, name: str) -> "Flow":
+        from .attributes import Attributes
+        return self.with_attributes(Attributes.name(name))
 
     # -- operator library (reference: scaladsl/Flow.scala ~200 defs;
     #    the stages live in akka_tpu/stream/ops.py) --------------------------
@@ -846,8 +907,11 @@ class Flow:
         return flow
 
     def collect_type(self, cls) -> "Flow":
-        """Pass through only instances of `cls` (scaladsl collectType)."""
-        return self.collect(lambda x: x if isinstance(x, cls) else None)
+        """Pass through only instances of `cls` (scaladsl collectType).
+        A dedicated filter, not collect's None-sentinel: a legitimate None
+        element matching `cls` (e.g. collect_type(object)) must survive
+        (ADVICE r3)."""
+        return self.filter(lambda x: isinstance(x, cls))
 
     def flat_map_prefix(self, n: int, fn) -> "Flow":
         """Consume the first n elements, then run the REST of the stream
@@ -968,6 +1032,15 @@ class Sink:
 
     def __init__(self, build: Callable[[_Builder, Outlet], Any]):
         self._build = build
+
+    def with_attributes(self, attrs) -> "Sink":
+        return Sink(_scoped_attributes(self._build, attrs))
+
+    add_attributes = with_attributes
+
+    def named(self, name: str) -> "Sink":
+        from .attributes import Attributes
+        return self.with_attributes(Attributes.name(name))
 
     @staticmethod
     def from_graph(stage_factory: Callable[[], GraphStage]) -> "Sink":

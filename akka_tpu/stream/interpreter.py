@@ -287,11 +287,54 @@ class GraphInterpreter:
                     return
                 c.out_closed = True
                 c.out_logic.out_handler(c.outlet).on_downstream_finish(None)
-        except Exception as e:  # noqa: BLE001 — operator threw: tear down
-            # (reference: GraphInterpreter catches and fails the stage)
+        except Exception as e:  # noqa: BLE001 — operator threw
+            # consult the stage's supervision decider (Attributes
+            # supervisionStrategy; Supervision.scala). Element-processing
+            # events (push = user fn on an element; pull = source producing
+            # one) may resume/restart; lifecycle events always stop.
             failing = c.in_logic if kind in ("push", "complete", "fail") \
                 else c.out_logic
+            if kind in ("push", "pull") and self._supervise(kind, c, failing, e):
+                return
             failing.fail_stage(e)
+
+    def _supervise(self, kind: str, c: Connection, failing, ex) -> bool:
+        """Apply the failing stage's supervision decider. Returns True if
+        the failure was absorbed (element dropped, stream kept running)."""
+        from .attributes import Supervision, effective_decider_of
+        try:
+            directive = effective_decider_of(failing)(ex)
+        except Exception:  # noqa: BLE001 — a throwing decider means stop
+            return False
+        if directive not in (Supervision.resume, Supervision.restart):
+            return False
+        if directive == Supervision.restart and \
+                failing.restart_state is not None:
+            try:
+                failing.restart_state()
+            except Exception:  # noqa: BLE001 — reset failed: tear down
+                return False
+        if kind == "push":
+            # drop the element; restore the port and the demand so the
+            # stream keeps flowing (reference Ops.scala collectors pull
+            # after a supervised drop)
+            if c.state in ("pushed", "grabbed"):
+                c.state = "idle"
+                c.element = None
+            if c.pending_complete and not c.in_closed:
+                # the dropped element was the last one and upstream already
+                # completed behind it: deliver the deferred completion (the
+                # happy-path re-queue in _process was skipped by the throw)
+                c.pending_complete = False
+                self.queue.append(("complete", c))
+            elif not c.in_closed and not c.out_closed:
+                self.pull(failing, c.inlet)
+            return True
+        # pull: producing the element failed; leave the port pulled and
+        # retry (unfoldResource-with-resume semantics: read() is retried)
+        if c.state == "pulled" and not c.out_closed:
+            self.queue.append(("pull", c))
+        return True
 
     def _all_closed(self) -> bool:
         if any(lg._keep_going for lg in self.logics):

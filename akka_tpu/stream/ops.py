@@ -614,6 +614,9 @@ class Scan(_LinearStage):
     def create_logic(self):
         logic, in_, out, fn = self._logic(), self.in_, self.out, self.fn
         state = {"acc": self.zero, "sent_zero": False}
+        # Supervision.restart resets the aggregate to zero (Ops.scala Scan
+        # restart semantics); resume keeps the accumulated value
+        logic.restart_state = lambda: state.update(acc=self.zero)
 
         def on_pull():
             if not state["sent_zero"]:
@@ -644,6 +647,7 @@ class Fold(_LinearStage):
     def create_logic(self):
         logic, in_, out, fn = self._logic(), self.in_, self.out, self.fn
         state = {"acc": self.zero}
+        logic.restart_state = lambda: state.update(acc=self.zero)
 
         def on_push():
             state["acc"] = fn(state["acc"], logic.grab(in_))
@@ -1237,13 +1241,31 @@ class Log(_LinearStage):
         logic, in_, out = self._logic(), self.in_, self.out
         log_name, extract = self.log_name, self.extract
 
+        def _log(kind: str, msg: str):
+            log = logic.materializer.system.log if logic.materializer else None
+            if log is None:
+                return
+            # Attributes.log_levels picks the level per event kind
+            # (reference: ActorAttributes.logLevels honored by Ops.scala Log)
+            levels = ("debug", "debug", "error")
+            if logic.attributes is not None:
+                levels = logic.attributes.get("log_levels", levels)
+            level = dict(zip(("element", "finish", "failure"), levels))[kind]
+            getattr(log, level, log.debug)(msg)
+
         def on_push():
             elem = logic.grab(in_)
-            log = logic.materializer.system.log if logic.materializer else None
-            if log is not None:
-                log.debug(f"[{log_name}] element: {extract(elem)}")
+            _log("element", f"[{log_name}] element: {extract(elem)}")
             logic.push(out, elem)
-        logic.set_handler(in_, make_in_handler(on_push))
+
+        def on_finish():
+            _log("finish", f"[{log_name}] upstream finished")
+            logic.complete_stage()
+
+        def on_failure(ex):
+            _log("failure", f"[{log_name}] upstream failed: {ex!r}")
+            logic.fail_stage(ex)
+        logic.set_handler(in_, make_in_handler(on_push, on_finish, on_failure))
         logic.set_handler(out, make_out_handler(lambda: logic.pull(in_)))
         return logic
 
@@ -1988,24 +2010,53 @@ _QUEUE_END = object()
 
 
 class SinkQueue:
-    """Mat value of Sink.queue: pull() -> Future[elem | QUEUE_END]."""
+    """Mat value of Sink.queue: pull() -> Future[elem | QUEUE_END];
+    cancel() tears the upstream down (reference SinkQueueWithCancel)."""
 
     def __init__(self):
         self._cb = None
+        self._cancel_cb = None
         self._lock = threading.Lock()
         self._early: List[Future] = []
+        self._early_cancel = False
         self._terminal = None  # ("complete",) | ("fail", ex) once drained
+        # every unresolved pull future: a pull dispatched into the stage's
+        # interpreter just before it shuts down would otherwise be dropped
+        # with the mailbox and never resolve — _set_terminal sweeps these
+        self._outstanding: List[Future] = []
 
-    def _bind(self, cb):
+    def _bind(self, cb, cancel_cb=None):
         with self._lock:
-            self._cb = cb
+            self._cb, self._cancel_cb = cb, cancel_cb
             early, self._early = self._early, []
+            do_cancel = self._early_cancel
         for fut in early:
             self._cb.invoke(fut)
+        if do_cancel and cancel_cb is not None:
+            cancel_cb.invoke(None)
+
+    def cancel(self) -> None:
+        with self._lock:
+            if self._terminal is not None:
+                return
+            cb = self._cancel_cb
+            if cb is None:
+                self._early_cancel = True
+                return
+        cb.invoke(None)
 
     def _set_terminal(self, done) -> None:
         with self._lock:
             self._terminal = done
+            swept = [f for f in self._outstanding if not f.done()]
+            self._outstanding = []
+        for fut in swept:
+            if fut.done():
+                continue
+            if done[0] == "complete":
+                fut.set_result(_QUEUE_END)
+            else:
+                fut.set_exception(done[1])
 
     def pull(self) -> Future:
         fut: Future = Future()
@@ -2017,6 +2068,11 @@ class SinkQueue:
                 else:
                     fut.set_exception(self._terminal[1])
                 return fut
+            # prune resolved futures so a long-lived consumer doesn't pin
+            # one Future (and its element) per pull for the stream's life
+            self._outstanding = [f for f in self._outstanding
+                                 if not f.done()]
+            self._outstanding.append(fut)
             if self._cb is None:
                 self._early.append(fut)
                 return fut
@@ -2042,10 +2098,23 @@ class QueueSink(_SinkStage):
                 # stay alive after upstream completes until the buffer is
                 # pulled dry (reference: QueueSink setKeepGoing(true))
                 self.set_keep_going(True)
-                mat._bind(self.get_async_callback(self._on_pull_req))
+                mat._bind(self.get_async_callback(self._on_pull_req),
+                          self.get_async_callback(self._on_cancel_req))
                 self.pull(in_)
 
+            def _on_cancel_req(self, _):
+                if state["done"] is None:
+                    state["done"] = ("complete",)
+                buf.clear()
+                while waiters:
+                    waiters.popleft().set_result(_QUEUE_END)
+                if not self.is_closed(in_):
+                    self.cancel(in_)
+                self._finish_drained()
+
             def _on_pull_req(self, fut: Future):
+                if fut.done():
+                    return  # already swept by _set_terminal
                 if buf:
                     fut.set_result(buf.popleft())
                     if not buf and state["done"] is not None:

@@ -53,14 +53,22 @@ class DivertToStage(GraphStage):
             elem = logic.grab(in_)
             target = divert if when(elem) else main
             if logic.is_closed(target):
-                _maybe_pull()  # route closed: drop, keep the stream moving
+                # reference parity: divertTo is Partition(eagerCancel=true)
+                # — losing either route cancels the whole stream, so no
+                # element is ever silently dropped (ADVICE r3)
+                logic.complete_stage()
             else:
                 logic.push(target, elem)
+
+        def on_downstream_finish(cause=None):
+            # eagerCancel: either outlet closing tears the stage down
+            logic.cancel_stage(cause)
 
         logic.set_handler(in_, make_in_handler(
             on_push, lambda: logic.complete_stage()))
         for o in (main, divert):
-            logic.set_handler(o, make_out_handler(_maybe_pull))
+            logic.set_handler(o, make_out_handler(_maybe_pull,
+                                                  on_downstream_finish))
         return logic
 
 
@@ -336,6 +344,12 @@ class FoldAsync(_LinearStage):
 
         def _finish():
             if emit_each:
+                if not state["emitted_zero"]:
+                    # upstream finished before the first downstream pull:
+                    # scan still owes the zero (reference Scan always
+                    # emits it; ADVICE r3 — this was timing-dependent)
+                    state["emitted_zero"] = True
+                    logic.emit(out, state["acc"])
                 logic.complete(out)
             elif logic.is_available(out):
                 logic.push(out, state["acc"])
@@ -467,4 +481,63 @@ class NeverSource(GraphStage):
     def create_logic(self):
         logic = GraphStageLogic(self._shape)
         logic.set_handler(self.out, make_out_handler(lambda: None))
+        return logic
+
+
+class UnfoldResourceSource(GraphStage):
+    """Source.unfoldResource as a real stage: the resource is opened at
+    pre_start and closed in post_stop, which the interpreter runs on EVERY
+    termination path — exhaustion, stage failure, and downstream cancel
+    (reference: impl/UnfoldResourceSource.scala; the close must not wait
+    for GC)."""
+
+    def __init__(self, create: Callable[[], Any],
+                 read: Callable[[Any], Optional[Any]],
+                 close: Callable[[Any], None]):
+        self.name = "UnfoldResourceSource"
+        self.create = create
+        self.read = read
+        self.close = close
+        self.out = Outlet("UnfoldResource.out")
+        self._shape = SourceShape(self.out)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    def create_logic(self):
+        stage = self
+        out = self.out
+        state = {"resource": None, "open": False}
+
+        class _L(GraphStageLogic):
+            def pre_start(self):
+                state["resource"] = stage.create()
+                state["open"] = True
+
+            def post_stop(self):
+                if state["open"]:
+                    state["open"] = False
+                    stage.close(state["resource"])
+
+        logic = _L(self._shape)
+
+        def _reopen():
+            # Supervision.restart: close the (possibly wedged) resource and
+            # open a fresh one before the retried read (reference
+            # UnfoldResourceSource restartState)
+            if state["open"]:
+                state["open"] = False
+                stage.close(state["resource"])
+            state["resource"] = stage.create()
+            state["open"] = True
+        logic.restart_state = _reopen
+
+        def on_pull():
+            v = stage.read(state["resource"])
+            if v is None:
+                logic.complete(out)
+            else:
+                logic.push(out, v)
+        logic.set_handler(out, make_out_handler(on_pull))
         return logic

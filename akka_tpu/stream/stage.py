@@ -156,6 +156,14 @@ class GraphStageLogic:
         self._emit_queues: Dict[int, List[Any]] = {}
         self._closed = False
         self._keep_going = False
+        # stamped by the builder from the enclosing with_attributes section
+        # (Attributes.scala analogue); consulted by the interpreter for the
+        # supervision decider
+        self.attributes = None
+        # stages with accumulated state set this to a zero-state reset
+        # callback; the Supervision.restart directive invokes it (the
+        # reference's restart recreating operator state, Ops.scala Scan etc.)
+        self.restart_state: Optional[Callable[[], None]] = None
 
     # -- wiring ---------------------------------------------------------------
     def set_handler(self, port, handler) -> None:

@@ -1,0 +1,335 @@
+"""Supervision attributes + Restart combinators — modeled on the
+reference's FlowSupervisionSpec / ActorGraphInterpreterSpec supervision
+cases (akka-stream-tests/.../FlowSupervisionSpec.scala) and
+RestartSpec.scala (RestartSource/Flow/Sink withBackoff)."""
+
+import time
+
+import pytest
+
+from akka_tpu import ActorSystem
+from akka_tpu.stream import (Attributes, Flow, Keep, RestartFlow,
+                             RestartSettings, RestartSink, RestartSource,
+                             Sink, Source, Supervision)
+from akka_tpu.stream.tck import verify_identity_processor, verify_publisher
+
+CFG = {"akka": {"stdout-loglevel": "OFF", "log-dead-letters": 0}}
+
+FAST = RestartSettings(min_backoff=0.02, max_backoff=0.1, random_factor=0.0)
+
+
+@pytest.fixture(scope="module")
+def system():
+    s = ActorSystem.create("stream-supervision-test", CFG)
+    yield s
+    s.terminate()
+    s.await_termination(10.0)
+
+
+def run_seq(source, system, timeout=5.0):
+    return source.run_with(Sink.seq(), system).result(timeout)
+
+
+def _boom_on(bad):
+    def fn(x):
+        if x == bad:
+            raise ValueError(f"boom on {x}")
+        return x
+    return fn
+
+
+# -- supervision deciders -----------------------------------------------------
+
+def test_default_decider_stops_the_stream(system):
+    fut = Source.from_iterable(range(5)).map(_boom_on(2)) \
+        .run_with(Sink.seq(), system)
+    with pytest.raises(ValueError):
+        fut.result(5.0)
+
+
+def test_resume_skips_the_failing_element(system):
+    out = run_seq(
+        Source.from_iterable(range(6))
+        .via(Flow().map(_boom_on(2)).with_attributes(
+            Attributes.supervision_strategy(Supervision.resuming_decider))),
+        system)
+    assert out == [0, 1, 3, 4, 5]
+
+
+def test_resume_on_filter_predicate_failure(system):
+    out = run_seq(
+        Source.from_iterable(range(6))
+        .via(Flow().filter(lambda x: (x % 2 == 0) if x != 3 else 1 // 0)
+             .with_attributes(Attributes.supervision_strategy(
+                 Supervision.resuming_decider))),
+        system)
+    assert out == [0, 2, 4]
+
+
+def test_restart_resets_scan_state_resume_keeps_it(system):
+    # resume: accumulated sum survives the dropped element
+    resumed = run_seq(
+        Source.from_iterable([1, 2, 100, 3])
+        .via(Flow().scan(0, lambda acc, x:
+                         acc + x if x != 100 else 1 // 0)
+             .with_attributes(Attributes.supervision_strategy(
+                 Supervision.resuming_decider))),
+        system)
+    assert resumed == [0, 1, 3, 6]
+    # restart: the aggregate is reset to zero when the fn fails
+    restarted = run_seq(
+        Source.from_iterable([1, 2, 100, 3])
+        .via(Flow().scan(0, lambda acc, x:
+                         acc + x if x != 100 else 1 // 0)
+             .with_attributes(Attributes.supervision_strategy(
+                 Supervision.restarting_decider))),
+        system)
+    assert restarted == [0, 1, 3, 3]
+
+
+def test_attributes_scope_is_the_wrapped_section_only(system):
+    # the throwing map sits AFTER with_attributes -> outside the resumed
+    # section -> the default stop decider applies and the stream fails
+    fut = (Source.from_iterable(range(5))
+           .via(Flow().map(lambda x: x).with_attributes(
+               Attributes.supervision_strategy(Supervision.resuming_decider))
+               .map(_boom_on(2)))
+           .run_with(Sink.seq(), system))
+    with pytest.raises(ValueError):
+        fut.result(5.0)
+
+
+def test_innermost_attributes_win(system):
+    # outer section says resume, inner section pins stop for its stage
+    fut = (Source.from_iterable(range(5))
+           .via(Flow()
+                .via(Flow().map(_boom_on(2)).with_attributes(
+                    Attributes.supervision_strategy(
+                        Supervision.stopping_decider)))
+                .with_attributes(Attributes.supervision_strategy(
+                    Supervision.resuming_decider)))
+           .run_with(Sink.seq(), system))
+    with pytest.raises(ValueError):
+        fut.result(5.0)
+
+
+def test_source_side_resume_retries_production(system):
+    # unfold whose fn fails ONCE mid-stream: resume retries the pull
+    state = {"failed": False}
+
+    def fn(s):
+        if s == 3 and not state["failed"]:
+            state["failed"] = True
+            raise RuntimeError("transient")
+        return (s + 1, s) if s < 6 else None
+
+    out = run_seq(
+        Source.unfold(0, fn).with_attributes(
+            Attributes.supervision_strategy(Supervision.resuming_decider)),
+        system)
+    assert out == [0, 1, 2, 3, 4, 5]
+
+
+def test_named_and_name_attribute(system):
+    src = Source.from_iterable([1]).named("my-source")
+    assert run_seq(src, system) == [1]
+    attrs = Attributes.name("a").and_then(Attributes.name("b"))
+    assert attrs.get("name") == "b"
+
+
+def test_supervised_flow_passes_identity_tck(system):
+    verify_identity_processor(
+        lambda: Flow().map(lambda x: x).with_attributes(
+            Attributes.supervision_strategy(Supervision.resuming_decider)),
+        system)
+
+
+# -- RestartSource ------------------------------------------------------------
+
+def test_restart_source_rematerializes_after_failure(system):
+    attempts = {"n": 0}
+
+    def factory():
+        attempts["n"] += 1
+        if attempts["n"] == 1:
+            return Source.from_iterable([1, 2]).concat(
+                Source.failed(RuntimeError("die")))
+        return Source.from_iterable([3, 4])
+
+    out = run_seq(
+        RestartSource.on_failures_with_backoff(FAST, factory), system)
+    assert out == [1, 2, 3, 4]
+    assert attempts["n"] == 2
+
+
+def test_restart_source_with_backoff_restarts_on_completion(system):
+    attempts = {"n": 0}
+
+    def factory():
+        attempts["n"] += 1
+        return Source.single(attempts["n"])
+
+    out = run_seq(
+        RestartSource.with_backoff(FAST, factory).take(3), system)
+    assert out == [1, 2, 3]
+    assert attempts["n"] >= 3
+
+
+def test_restart_source_max_restarts_propagates_failure(system):
+    settings = RestartSettings(min_backoff=0.01, max_backoff=0.02,
+                               random_factor=0.0, max_restarts=2,
+                               max_restarts_within=60.0)
+    fut = RestartSource.on_failures_with_backoff(
+        settings, lambda: Source.failed(RuntimeError("always"))) \
+        .run_with(Sink.seq(), system)
+    with pytest.raises(RuntimeError):
+        fut.result(5.0)
+
+
+def test_restart_source_backoff_grows(system):
+    stamps = []
+
+    def factory():
+        stamps.append(time.monotonic())
+        return Source.failed(RuntimeError("die"))
+
+    settings = RestartSettings(min_backoff=0.05, max_backoff=1.0,
+                               random_factor=0.0, max_restarts=3,
+                               max_restarts_within=60.0)
+    fut = RestartSource.on_failures_with_backoff(settings, factory) \
+        .run_with(Sink.seq(), system)
+    with pytest.raises(RuntimeError):
+        fut.result(5.0)
+    gaps = [b - a for a, b in zip(stamps, stamps[1:])]
+    assert len(gaps) == 3
+    # exponential: ~0.05, ~0.1, ~0.2
+    assert gaps[0] >= 0.04
+    assert gaps[1] >= 0.08
+    assert gaps[2] >= 0.16
+
+
+def test_restart_source_passes_publisher_tck(system):
+    verify_publisher(
+        lambda n: RestartSource.on_failures_with_backoff(
+            FAST, lambda: Source.from_iterable(range(n))), system)
+
+
+# -- RestartFlow --------------------------------------------------------------
+
+def test_restart_flow_survives_inner_failure(system):
+    out = run_seq(
+        Source.from_iterable([1, 2, 3, 4, 5]).via(
+            RestartFlow.with_backoff(
+                FAST, lambda: Flow().map(_boom_on(3)))),
+        system)
+    # the failing element is lost across the restart (at-most-once wrap)
+    assert out == [1, 2, 4, 5]
+
+
+def test_restart_flow_completes_when_upstream_completes(system):
+    out = run_seq(
+        Source.from_iterable(range(4)).via(
+            RestartFlow.with_backoff(
+                FAST, lambda: Flow().map(lambda x: x * 10))),
+        system)
+    assert out == [0, 10, 20, 30]
+
+
+# -- RestartSink --------------------------------------------------------------
+
+def test_restart_sink_rematerializes_and_keeps_consuming(system):
+    seen = []
+    armed = {"on": True}
+
+    def factory():
+        def consume(x):
+            if x == 3 and armed["on"]:
+                armed["on"] = False
+                raise RuntimeError("die on 3")
+            seen.append(x)
+        return Sink.foreach(consume)
+
+    Source.from_iterable([1, 2, 3, 4, 5]).to(
+        RestartSink.with_backoff(FAST, factory)).run(system)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and 5 not in seen:
+        time.sleep(0.01)
+    # 3 was in flight at the failure (lost, at-most-once wrap);
+    # consumption continues after the rematerialization
+    assert seen == [1, 2, 4, 5]
+
+
+def test_restart_sink_public_api(system):
+    seen = []
+    fails = {"armed": True}
+
+    def factory():
+        def consume(x):
+            if x == 2 and fails["armed"]:
+                fails["armed"] = False
+                raise RuntimeError("transient")
+            seen.append(x)
+        return Sink.foreach(consume)
+
+    Source.from_iterable([1, 2, 3]).to(
+        RestartSink.with_backoff(FAST, factory)).run(system)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and 3 not in seen:
+        time.sleep(0.01)
+    # 2 was in flight at the failure (lost); 3 arrives after the restart
+    assert seen == [1, 3]
+
+
+# -- wired attributes ---------------------------------------------------------
+
+def test_input_buffer_attribute_sizes_async_boundary(system):
+    out = run_seq(
+        Source.from_iterable(range(20))
+        .via(Flow().map(lambda x: x).async_())
+        .via(Flow().map(lambda x: x + 1).with_attributes(
+            Attributes.input_buffer(1, 2))),
+        system)
+    assert out == list(range(1, 21))
+
+
+def test_restart_decider_reopens_unfold_resource(system):
+    opened, closed = [], []
+
+    def create():
+        opened.append(len(opened))
+        return {"reads": 0, "id": len(opened) - 1}
+
+    def read(r):
+        r["reads"] += 1
+        if r["id"] == 0 and r["reads"] == 3:
+            raise RuntimeError("wedged handle")
+        if r["reads"] > 4:
+            return None
+        return (r["id"], r["reads"])
+
+    out = run_seq(
+        Source.unfold_resource(create, read, lambda r: closed.append(r["id"]))
+        .with_attributes(Attributes.supervision_strategy(
+            Supervision.restarting_decider)),
+        system)
+    # resource 0 read twice, wedged on the 3rd -> reopened as resource 1
+    assert opened == [0, 1]
+    assert closed == [0, 1]
+    assert out == [(0, 1), (0, 2), (1, 1), (1, 2), (1, 3), (1, 4)]
+
+
+def test_resume_on_last_element_still_completes(system):
+    # the dropped element was the final one, with upstream completion
+    # already pending behind it: the stream must still complete
+    out = run_seq(
+        Source.from_iterable([1, 2, 3])
+        .via(Flow().map(_boom_on(3)).with_attributes(
+            Attributes.supervision_strategy(Supervision.resuming_decider))),
+        system)
+    assert out == [1, 2]
+    out = run_seq(
+        Source.single(1)
+        .via(Flow().map(_boom_on(1)).with_attributes(
+            Attributes.supervision_strategy(Supervision.resuming_decider))),
+        system)
+    assert out == []
