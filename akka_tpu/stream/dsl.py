@@ -28,6 +28,7 @@ from .stage import (FlowShape, GraphStage, GraphStageLogic, Inlet, Outlet,
 from . import ops as _ops
 from . import ops2 as _ops2
 from . import ops3 as _ops3
+from . import ops4 as _ops4
 
 
 def _map_future(fut: Future, fn) -> Future:
@@ -398,6 +399,89 @@ class Source:
         return Source.from_graph(lambda: _ops3.NeverSource())
 
     @staticmethod
+    def maybe() -> "Source":
+        """Mat: a MaybePromise — success(elem) emits-and-completes,
+        success(None) completes empty, failure(ex) fails
+        (scaladsl Source.maybe)."""
+        return Source.from_graph(lambda: _ops4.MaybeSource())
+
+    @staticmethod
+    def range(start: int, end: int, step: int = 1) -> "Source":
+        """Emit start..end INCLUSIVE by step (javadsl Source.range)."""
+        return Source.from_iterable(range(
+            start, end + (1 if step > 0 else -1), step))
+
+    @staticmethod
+    def from_iterator(factory) -> "Source":
+        """A FRESH iterator per materialization (Source.fromIterator) —
+        unlike from_iterable, the factory is called each run."""
+        class _PerRun:
+            def __iter__(self):
+                return iter(factory())
+        return Source.from_graph(lambda: _ops.IterableSource(_PerRun()))
+
+    @staticmethod
+    def unfold_async(zero, fn) -> "Source":
+        """unfoldAsync: fn(state) -> Future[None | (state, elem)]."""
+        return Source.from_graph(lambda: _ops4.UnfoldAsync(zero, fn))
+
+    @staticmethod
+    def unfold_resource_async(create, read, close) -> "Source":
+        """unfoldResourceAsync: create/read/close may return Futures; read
+        resolving None completes; close runs on every termination path."""
+        return Source.from_graph(
+            lambda: _ops4.UnfoldResourceAsync(create, read, close))
+
+    @staticmethod
+    def actor_ref_with_backpressure(ack_message) -> "Source":
+        """Mat: Future[ActorRef]; the ref replies `ack_message` to each
+        sender once its element is accepted
+        (Source.actorRefWithBackpressure)."""
+        return Source.from_graph(
+            lambda: _ops4.ActorRefBackpressureSource(ack_message))
+
+    @staticmethod
+    def zip_n(sources: Sequence["Source"]) -> "Source":
+        """zipN: emit lists of one element from every source."""
+        return Source.zip_with_n(lambda xs: list(xs), sources)
+
+    @staticmethod
+    def zip_with_n(fn, sources: Sequence["Source"]) -> "Source":
+        """zipWithN: emit fn([heads...]) per zipped row."""
+        builds = [s._build for s in sources]
+
+        def build(b: _Builder):
+            logic, _ = b.add(_ops4.ZipNStage(len(builds), fn))
+            mat0 = None
+            for i, sb in enumerate(builds):
+                o, m = sb(b)
+                if i == 0:
+                    mat0 = m
+                b.connect(o, logic.shape.ins[i])
+            return logic.shape.out, mat0
+        return Source(build)
+
+    @staticmethod
+    def merge_prioritized_n(sources_and_priorities) -> "Source":
+        """mergePrioritizedN: [(source, priority)] — higher priority wins
+        when several inputs have an element buffered."""
+        pairs = list(sources_and_priorities)
+        builds = [s._build for s, _p in pairs]
+        prios = [p for _s, p in pairs]
+
+        def build(b: _Builder):
+            from .ops3 import MergePrioritizedStage
+            logic, _ = b.add(MergePrioritizedStage(prios))
+            mat0 = None
+            for i, sb in enumerate(builds):
+                o, m = sb(b)
+                if i == 0:
+                    mat0 = m
+                b.connect(o, logic.shape.ins[i])
+            return logic.shape.out, mat0
+        return Source(build)
+
+    @staticmethod
     def lazy_source(factory: Callable[[], "Source"]) -> "Source":
         """Defer building the inner Source until the stream is pulled
         (scaladsl Source.lazySource)."""
@@ -493,6 +577,46 @@ class Source:
 
     def prepend(self, other: "Source") -> "Source":
         return other.concat(self)
+
+    def concat_lazy(self, other: "Source") -> "Source":
+        """concatLazy: `other` is not built until this source completes
+        and it is actually pulled (scaladsl concatLazy)."""
+        return self.concat(Source.lazy_source(lambda: other))
+
+    def prepend_lazy(self, other: "Source") -> "Source":
+        """prependLazy (scaladsl prependLazy)."""
+        return Source.lazy_source(lambda: other).concat(self)
+
+    def map_materialized_value(self, fn) -> "Source":
+        """mapMaterializedValue: transform this Source's mat value."""
+        prev = self._build
+
+        def build(b: _Builder):
+            o, m = prev(b)
+            return o, fn(m)
+        return Source(build)
+
+    def pre_materialize(self, materializer_or_system):
+        """preMaterialize: run this source NOW; returns (mat, Source) where
+        the Source replays the running stream's elements to one consumer
+        (scaladsl Source.preMaterialize, via a queue bridge)."""
+        pair = self.to_mat(Sink.queue(), Keep.both).run(materializer_or_system)
+        mat, queue = pair
+
+        def fn(state):
+            fut = queue.pull()
+            out: Future = Future()
+
+            def done(f):
+                if f.exception() is not None:
+                    out.set_exception(f.exception())
+                elif f.result() is _ops._QUEUE_END:
+                    out.set_result(None)
+                else:
+                    out.set_result((state, f.result()))
+            fut.add_done_callback(done)
+            return out
+        return mat, Source.unfold_async(None, fn)
 
     def or_else(self, other: "Source") -> "Source":
         b1, b2 = self._build, other._build
@@ -618,6 +742,60 @@ class Flow:
     @staticmethod
     def from_function(fn: Callable[[Any], Any]) -> "Flow":
         return Flow().map(fn)
+
+    @staticmethod
+    def from_sink_and_source(sink: "Sink", source: "Source") -> "Flow":
+        """fromSinkAndSource: inputs go to `sink`, outputs come from
+        `source`; the two sides are NOT coupled (scaladsl
+        Flow.fromSinkAndSource)."""
+        sink_build, src_build = sink._build, source._build
+
+        def build(b: _Builder, upstream: Outlet):
+            m1 = sink_build(b, upstream)
+            o, m2 = src_build(b)
+            return o, (m1, m2)
+        return Flow(build)
+
+    @staticmethod
+    def from_sink_and_source_coupled(sink: "Sink", source: "Source") -> "Flow":
+        """fromSinkAndSourceCoupled: like from_sink_and_source but
+        termination of either side tears down the other (coupled through a
+        per-materialization shared kill switch — the reference's
+        CoupledTerminationFlow)."""
+        sink_build, src_build = sink._build, source._build
+
+        def build(b: _Builder, upstream: Outlet):
+            from .killswitch import KillSwitches
+            ks = KillSwitches.shared("coupled")
+            watched = Flow().via(ks.flow).watch_termination()  # .flow is a property
+
+            o1, fut1 = watched._build(b, upstream)
+            m1 = sink_build(b, o1)
+            fut1.add_done_callback(lambda _f: ks.shutdown())
+
+            o2, m2 = src_build(b)
+            o3, fut2 = watched._build(b, o2)
+            fut2.add_done_callback(lambda _f: ks.shutdown())
+            return o3, (m1, m2)
+        return Flow(build)
+
+    @staticmethod
+    def lazy_flow(factory: Callable[[], "Flow"]) -> "Flow":
+        """lazyFlow: defer building the inner Flow until the first element
+        arrives; that element and all following flow through it
+        (scaladsl Flow.lazyFlow, via flatMapPrefix(1))."""
+        def with_first(prefix):
+            inner = factory()
+            inner_build = inner._build
+
+            def build(b: _Builder, upstream: Outlet):
+                head, _ = b.add(_ops.IterableSource(list(prefix)))
+                concat, _ = b.add(_ops.ConcatStage(2))
+                b.connect(head.shape.outlets[0], concat.shape.ins[0])
+                b.connect(upstream, concat.shape.ins[1])
+                return inner_build(b, concat.shape.out)
+            return Flow(build)
+        return Flow().flat_map_prefix(1, with_first)
 
     def _append(self, op_factory: Callable[[], GraphStage],
                 combine=Keep.left) -> "Flow":
@@ -934,6 +1112,121 @@ class Flow:
             flow = flow.prepend(Source.single(initial))
         return flow
 
+    # -- fourth operator tranche (scaladsl/Flow.scala long tail) -------------
+    def stateful_map(self, create, fn, on_complete=None) -> "Flow":
+        """statefulMap(create)(f, onComplete): f(state, elem) ->
+        (state, out); onComplete(state) may emit one final element."""
+        return self._append(lambda: _ops4.StatefulMap(create, fn, on_complete))
+
+    def map_with_resource(self, create, fn, close) -> "Flow":
+        """mapWithResource: per-materialization resource used by
+        fn(resource, elem), closed on every termination path."""
+        return self._append(lambda: _ops4.MapWithResource(create, fn, close))
+
+    def map_async_partitioned(self, parallelism: int, partitioner,
+                              fn) -> "Flow":
+        """mapAsyncPartitioned: one future in flight per partition,
+        results in input order; fn(elem, partition) -> Future | value."""
+        return self._append(lambda: _ops4.MapAsyncPartitioned(
+            parallelism, partitioner, fn))
+
+    def grouped_weighted(self, min_weight: float, cost) -> "Flow":
+        return self._append(lambda: _ops4.GroupedWeighted(min_weight, cost))
+
+    def grouped_weighted_within(self, max_weight: float, seconds: float,
+                                cost, max_number: int = 0) -> "Flow":
+        return self._append(lambda: _ops4.GroupedWeightedWithin(
+            max_weight, seconds, cost, max_number))
+
+    def batch_weighted(self, max_weight: float, cost, seed,
+                       aggregate) -> "Flow":
+        return self._append(lambda: _ops4.BatchWeighted(
+            max_weight, cost, seed, aggregate))
+
+    def initial_delay(self, seconds: float) -> "Flow":
+        return self._append(lambda: _ops4.InitialDelay(seconds))
+
+    def backpressure_timeout(self, seconds: float) -> "Flow":
+        return self._append(lambda: _ops4.BackpressureTimeout(seconds))
+
+    def delay_with(self, strategy_factory, buffer_size: int = 16) -> "Flow":
+        """delayWith(DelayStrategy): strategy_factory() -> fn(elem) ->
+        seconds, fresh per materialization."""
+        return self._append(lambda: _ops4.DelayWith(strategy_factory,
+                                                    buffer_size))
+
+    def monitor(self) -> "Flow":
+        """monitor: mat value is a FlowMonitor exposing the stream's last
+        state (initialized/received/failed/finished)."""
+        return self._append(lambda: _ops4.MonitorStage(), combine=Keep.right)
+
+    def fold_while(self, zero, pred, fn) -> "Flow":
+        """foldWhile(zero)(pred)(f): stop folding (and cancel upstream)
+        once pred(acc) is false; emits the aggregate."""
+        return self._append(lambda: _ops4.FoldWhile(zero, pred, fn))
+
+    def merge_latest(self, other: Source) -> "Flow":
+        """mergeLatest: after both inputs emitted once, emit [a, b] on
+        every update from either side."""
+        return self._fan_in(other, lambda: _ops4.MergeLatestStage(2))
+
+    def merge_latest_with(self, other: Source, fn) -> "Flow":
+        return self._fan_in(other, lambda: _ops4.MergeLatestStage(
+            2, lambda xs: fn(*xs)))
+
+    def ask(self, parallelism: int, ref, timeout: float = 5.0) -> "Flow":
+        """ask: each element is asked to `ref`; replies emitted in order
+        (scaladsl Flow.ask via mapAsync + pattern.ask)."""
+        from ..pattern.ask import ask as _ask
+
+        def do_ask(elem):
+            return _ask(ref, elem, timeout)
+        return self.map_async(parallelism, do_ask)
+
+    def watch(self, ref) -> "Flow":
+        """watch(ref): fail the stream with
+        WatchedActorTerminatedException when `ref` terminates."""
+        return self._append(lambda: _ops4.WatchStage(ref))
+
+    def detach(self) -> "Flow":
+        """detach: decouple upstream/downstream rates with a one-element
+        pump (the reference's Detacher; a 1-slot backpressure buffer)."""
+        return self.buffer(1, "backpressure")
+
+    def recover_with(self, fn) -> "Flow":
+        """recoverWith: switch to fn(exception)'s Source on failure,
+        unlimited retries (recoverWithRetries(-1))."""
+        return self.recover_with_retries(-1, fn)
+
+    def collect_first(self, fn) -> "Flow":
+        """collectFirst: emit the first element fn maps non-None, then
+        complete."""
+        return self.collect(fn).take(1)
+
+    def collect_while(self, fn) -> "Flow":
+        """collectWhile: map through fn until it first returns None, then
+        complete (fn evaluated once per element)."""
+        return self.map(fn).take_while(lambda v: v is not None)
+
+    def flatten_merge(self, breadth: int = 8) -> "Flow":
+        """flattenMerge: flatten a stream of Sources, running up to
+        `breadth` concurrently."""
+        return self.flat_map_merge(breadth, lambda s: s)
+
+    def switch_map(self, fn) -> "Flow":
+        """switchMap (flatMapLatest): a new element cancels the current
+        inner Source and switches to fn(elem)."""
+        return self._append(lambda: _ops4.SwitchMap(fn))
+
+    def map_materialized_value(self, fn) -> "Flow":
+        """mapMaterializedValue: transform this Flow's mat value."""
+        prev = self._build
+
+        def build(b: _Builder, upstream: Outlet):
+            o, m = prev(b, upstream)
+            return o, fn(m)
+        return Flow(build)
+
     def async_(self) -> "Flow":
         """Mark an ASYNC BOUNDARY: stages after this point run in their own
         island (one interpreter actor per island), with backpressure across
@@ -1057,6 +1350,31 @@ class Sink:
     @staticmethod
     def foreach(fn) -> "Sink":
         return Sink.from_graph(lambda: _ops.ForeachSink(fn))
+
+    @staticmethod
+    def foreach_async(parallelism: int, fn) -> "Sink":
+        """foreachAsync: fn(elem) -> Future; up to `parallelism` in
+        flight; mat Future completes at stream end."""
+        return Flow().map_async(parallelism, fn).to(
+            Sink.ignore(), Keep.right)
+
+    @staticmethod
+    def cancelled() -> "Sink":
+        """Sink.cancelled: immediately cancel upstream."""
+        return Sink.from_graph(lambda: _ops4.CancelledSink())
+
+    @staticmethod
+    def lazy_sink(factory: Callable[[], "Sink"]) -> "Sink":
+        """lazySink: build+materialize the real sink only when the first
+        element arrives (that element is delivered to it)."""
+        return Sink.from_graph(lambda: _ops4.LazySink(factory))
+
+    @staticmethod
+    def future_sink(fut: Future) -> "Sink":
+        """futureSink: materialize the Sink the future resolves to,
+        buffering demand until then."""
+        return Sink.from_graph(
+            lambda: _ops4.LazySink(lambda: fut.result(), trigger=fut))
 
     @staticmethod
     def seq() -> "Sink":
@@ -1289,6 +1607,12 @@ _SOURCE_MIRRORED_OPS = [
     "on_error_complete", "async_", "also_to_all", "merge_all",
     "interleave_all", "concat_all_lazy", "collect_type",
     "flat_map_prefix", "extrapolate",
+    "stateful_map", "map_with_resource", "map_async_partitioned",
+    "grouped_weighted", "grouped_weighted_within", "batch_weighted",
+    "initial_delay", "backpressure_timeout", "delay_with", "monitor",
+    "fold_while", "merge_latest", "merge_latest_with", "ask", "watch",
+    "detach", "recover_with", "collect_first", "collect_while",
+    "flatten_merge", "switch_map",
 ]
 
 

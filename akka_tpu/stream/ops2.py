@@ -350,10 +350,13 @@ class RecoverWithRetries(_LinearStage):
                 logic.complete_stage()
 
         def switch(ex):
-            if state["left"] <= 0:
+            # attempts < 0 = unlimited (scaladsl recoverWithRetries(-1) /
+            # recoverWith semantics)
+            if state["left"] == 0:
                 logic.fail_stage(ex)
                 return
-            state["left"] -= 1
+            if state["left"] > 0:
+                state["left"] -= 1
             state["fallback"] = True
             try:
                 src = stage.fn(ex)

@@ -1,0 +1,575 @@
+"""Behavior tests for the fourth operator tranche (VERDICT r3 #5) —
+modeled on the reference operator specs: FlowStatefulMapSpec,
+FlowMapWithResourceSpec, FlowMapAsyncPartitionedSpec, FlowGroupedWeightedSpec,
+FlowDelaySpec, FlowMonitorSpec, FlowWatchSpec, SourceSpec (maybe/unfoldAsync/
+zipWithN), LazySinkSpec, FlowSwitchMapSpec."""
+
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+
+import pytest
+
+from akka_tpu import ActorSystem
+from akka_tpu.stream import Flow, Keep, Sink, Source
+
+CFG = {"akka": {"stdout-loglevel": "OFF", "log-dead-letters": 0}}
+POOL = ThreadPoolExecutor(4)
+
+
+@pytest.fixture(scope="module")
+def system():
+    s = ActorSystem.create("stream-ops4-test", CFG)
+    yield s
+    s.terminate()
+    s.await_termination(10.0)
+
+
+def run_seq(source, system, timeout=5.0):
+    return source.run_with(Sink.seq(), system).result(timeout)
+
+
+def later(v, delay=0.01):
+    def work():
+        time.sleep(delay)
+        return v
+    return POOL.submit(work)
+
+
+# -- stateful element ops -----------------------------------------------------
+
+def test_stateful_map(system):
+    out = run_seq(
+        Source.from_iterable([1, 2, 3, 4]).stateful_map(
+            lambda: 0,
+            lambda s, x: (s + x, s + x),          # running sum
+            on_complete=lambda s: ("total", s)),
+        system)
+    assert out == [1, 3, 6, 10, ("total", 10)]
+
+
+def test_stateful_map_fresh_state_per_materialization(system):
+    src = Source.from_iterable([1, 1]).stateful_map(
+        lambda: 0, lambda s, x: (s + x, s + x))
+    assert run_seq(src, system) == [1, 2]
+    assert run_seq(src, system) == [1, 2]
+
+
+def test_map_with_resource(system):
+    closed = []
+
+    def close(r):
+        closed.append(r["n"])
+        return ("closed", r["n"])
+
+    out = run_seq(
+        Source.from_iterable([1, 2, 3]).map_with_resource(
+            lambda: {"n": 0},
+            lambda r, x: (r.__setitem__("n", r["n"] + 1), x * 10)[1],
+            close),
+        system)
+    assert out == [10, 20, 30, ("closed", 3)]
+    assert closed == [3]
+
+
+def test_map_with_resource_closes_on_cancel(system):
+    closed = []
+    out = run_seq(
+        Source.from_iterable(range(100)).map_with_resource(
+            lambda: "res", lambda r, x: x, lambda r: closed.append(r))
+        .take(2),
+        system)
+    assert out == [0, 1]
+    assert closed == ["res"]
+
+
+def test_map_async_partitioned_orders_and_serializes_partitions(system):
+    in_flight = {}
+    max_concurrent_per_part = {}
+
+    def fn(elem, part):
+        def work():
+            in_flight[part] = in_flight.get(part, 0) + 1
+            max_concurrent_per_part[part] = max(
+                max_concurrent_per_part.get(part, 0), in_flight[part])
+            time.sleep(0.01)
+            in_flight[part] = in_flight[part] - 1
+            return elem * 10
+        return POOL.submit(work)
+
+    out = run_seq(
+        Source.from_iterable(range(12)).map_async_partitioned(
+            4, lambda x: x % 3, fn),
+        system, timeout=10.0)
+    assert out == [x * 10 for x in range(12)]  # input order preserved
+    assert all(v == 1 for v in max_concurrent_per_part.values())
+
+
+# -- weighted grouping --------------------------------------------------------
+
+def test_grouped_weighted(system):
+    out = run_seq(
+        Source.from_iterable([1, 2, 3, 4, 5]).grouped_weighted(
+            3, lambda x: x),
+        system)
+    assert out == [[1, 2], [3], [4], [5]]
+
+
+def test_grouped_weighted_within_flushes_on_window(system):
+    out = run_seq(
+        Source.tick(0.01, 0.03, "t").take(3)
+        .grouped_weighted_within(100, 0.05, lambda x: 1),
+        system, timeout=10.0)
+    assert sum(len(g) for g in out) == 3
+    assert len(out) >= 2  # the window fired at least once mid-stream
+
+
+def test_batch_weighted(system):
+    # fast producer, slow consumer: batches aggregate by weight
+    out = run_seq(
+        Source.from_iterable(range(10)).batch_weighted(
+            100, lambda x: 1, lambda x: [x], lambda acc, x: acc + [x])
+        .delay(0.02),
+        system, timeout=10.0)
+    flat = [x for g in out for x in g]
+    assert flat == list(range(10))
+
+
+# -- timer ops ----------------------------------------------------------------
+
+def test_initial_delay(system):
+    t0 = time.monotonic()
+    out = run_seq(Source.from_iterable([1, 2, 3]).initial_delay(0.1), system)
+    assert out == [1, 2, 3]
+    assert time.monotonic() - t0 >= 0.09
+
+
+def test_backpressure_timeout_passes_fast_consumer(system):
+    out = run_seq(
+        Source.from_iterable(range(5)).backpressure_timeout(5.0), system)
+    assert out == list(range(5))
+
+
+def test_backpressure_timeout_fails_stuck_consumer(system):
+    from akka_tpu.stream.ops4 import BackpressureTimeoutException
+    fut = Source.from_iterable(range(5)) \
+        .backpressure_timeout(0.05) \
+        .map_async(1, lambda x: later(x, delay=10.0) if x else x) \
+        .run_with(Sink.seq(), system)
+    assert isinstance(fut.exception(10.0), BackpressureTimeoutException)
+
+
+def test_delay_with(system):
+    t0 = time.monotonic()
+    out = run_seq(
+        Source.from_iterable([1, 2]).delay_with(
+            lambda: (lambda elem: 0.05 * elem)),
+        system, timeout=10.0)
+    assert out == [1, 2]
+    assert time.monotonic() - t0 >= 0.1  # 0.05 + staggered 0.1
+
+
+# -- monitor / foldWhile / watch / detach ------------------------------------
+
+def test_monitor(system):
+    mon_holder = {}
+    out = (Source.from_iterable([1, 2, 3])
+           .via_mat(Flow().monitor().map_materialized_value(
+               lambda m: mon_holder.setdefault("m", m)), Keep.right)
+           .run_with(Sink.seq(), system))
+    assert out.result(5.0) == [1, 2, 3]
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline and \
+            mon_holder["m"].state[0] != "finished":
+        time.sleep(0.01)
+    assert mon_holder["m"].state == ("finished",)
+
+
+def test_fold_while(system):
+    # sum until the aggregate reaches 10; upstream is infinite
+    out = run_seq(
+        Source.repeat(3).fold_while(0, lambda acc: acc < 10,
+                                    lambda acc, x: acc + x),
+        system)
+    assert out == [12]
+
+
+def test_watch_fails_stream_when_actor_dies(system):
+    from akka_tpu.actor.props import Props
+    from akka_tpu.stream.ops4 import WatchedActorTerminatedException
+
+    ref = system.actor_of(Props.from_receive(lambda ctx, msg: None))
+    fut = Source.tick(0.01, 0.05, "x").watch(ref) \
+        .run_with(Sink.seq(), system)
+    time.sleep(0.1)
+    system.stop(ref)
+    assert isinstance(fut.exception(10.0), WatchedActorTerminatedException)
+
+
+def test_detach_passes_elements(system):
+    assert run_seq(Source.from_iterable(range(6)).detach(), system) \
+        == list(range(6))
+
+
+# -- compositional tail -------------------------------------------------------
+
+def test_recover_with(system):
+    out = run_seq(
+        Source.from_iterable([1, 2]).concat(Source.failed(ValueError("x")))
+        .recover_with(lambda ex: Source.from_iterable([8, 9])),
+        system)
+    assert out == [1, 2, 8, 9]
+
+
+def test_collect_first_and_collect_while(system):
+    out = run_seq(
+        Source.from_iterable([1, 3, 4, 5, 6]).collect_first(
+            lambda x: x * 10 if x % 2 == 0 else None),
+        system)
+    assert out == [40]
+    out = run_seq(
+        Source.from_iterable([2, 4, 5, 6]).collect_while(
+            lambda x: x * 10 if x % 2 == 0 else None),
+        system)
+    assert out == [20, 40]
+
+
+def test_flatten_merge(system):
+    out = run_seq(
+        Source.from_iterable([Source.from_iterable([1, 2]),
+                              Source.from_iterable([3, 4])])
+        .flatten_merge(2),
+        system)
+    assert sorted(out) == [1, 2, 3, 4]
+
+
+def test_switch_map_cancels_previous_inner(system):
+    # a new outer element switches away from the (infinite) previous inner
+    out = run_seq(
+        Source.from_iterable(["a", "b"])
+        .switch_map(lambda k: Source.tick(0.0, 0.01, k).take(50)
+                    if k == "a" else Source.from_iterable([k] * 3)),
+        system, timeout=10.0)
+    assert out[-3:] == ["b", "b", "b"]
+    assert len(out) < 53  # "a" was cut short by the switch
+
+
+def test_concat_lazy_and_prepend_lazy(system):
+    built = []
+
+    def make_second():
+        built.append(True)
+        return Source.from_iterable([3, 4])
+
+    src = Source.from_iterable([1, 2]).concat_lazy(
+        Source.lazy_source(make_second))
+    assert built == []  # not built before materialization+pull
+    assert run_seq(src, system) == [1, 2, 3, 4]
+    assert run_seq(
+        Source.from_iterable([3, 4]).prepend_lazy(Source.from_iterable([1])),
+        system) == [1, 3, 4]
+
+
+def test_map_materialized_value(system):
+    fut = Source.from_iterable([1, 2]) \
+        .map_materialized_value(lambda m: ("wrapped", m)) \
+        .run_with(Sink.seq(), system)
+    assert fut.result(5.0) == [1, 2]
+
+
+# -- async sources ------------------------------------------------------------
+
+def test_source_maybe_success(system):
+    src = Source.maybe()
+    from akka_tpu.stream import Materializer
+    pair = src.to_mat(Sink.seq(), Keep.both).run(Materializer(system))
+    promise, fut = pair
+    promise.success(42)
+    assert fut.result(5.0) == [42]
+
+
+def test_source_maybe_empty_and_failure(system):
+    from akka_tpu.stream import Materializer
+    promise, fut = Source.maybe().to_mat(Sink.seq(), Keep.both) \
+        .run(Materializer(system))
+    promise.success(None)
+    assert fut.result(5.0) == []
+    promise2, fut2 = Source.maybe().to_mat(Sink.seq(), Keep.both) \
+        .run(Materializer(system))
+    promise2.failure(RuntimeError("nope"))
+    assert isinstance(fut2.exception(5.0), RuntimeError)
+
+
+def test_unfold_async(system):
+    def fn(s):
+        if s >= 4:
+            return later(None)
+        return later((s + 1, s))
+    assert run_seq(Source.unfold_async(0, fn), system, timeout=10.0) \
+        == [0, 1, 2, 3]
+
+
+def test_unfold_resource_async(system):
+    closed = []
+
+    def create():
+        return later(iter([1, 2, 3]))
+
+    def read(it):
+        return later(next(it, None))
+
+    def close(it):
+        closed.append(True)
+        return later(True)
+
+    out = run_seq(Source.unfold_resource_async(create, read, close),
+                  system, timeout=10.0)
+    assert out == [1, 2, 3]
+    assert closed == [True]
+
+
+def test_zip_n_and_zip_with_n(system):
+    out = run_seq(Source.zip_n([Source.from_iterable([1, 2, 3]),
+                                Source.from_iterable("ab")]), system)
+    assert out == [[1, "a"], [2, "b"]]
+    out = run_seq(Source.zip_with_n(
+        lambda xs: sum(xs), [Source.from_iterable([1, 2]),
+                             Source.from_iterable([10, 20]),
+                             Source.from_iterable([100, 200])]), system)
+    assert out == [111, 222]
+
+
+def test_merge_latest(system):
+    out = run_seq(
+        Source.from_iterable([1]).merge_latest(
+            Source.from_iterable(["a", "b"])),
+        system)
+    # after both sides emitted, each update emits the latest pair
+    assert [1, "a"] in out or [1, "b"] in out
+    assert out[-1] == [1, "b"]
+
+
+def test_merge_prioritized_n(system):
+    out = run_seq(Source.merge_prioritized_n(
+        [(Source.from_iterable([1, 1]), 1),
+         (Source.from_iterable([9, 9]), 10)]), system)
+    assert sorted(out) == [1, 1, 9, 9]
+
+
+def test_source_range_and_from_iterator(system):
+    assert run_seq(Source.range(1, 5), system) == [1, 2, 3, 4, 5]
+    assert run_seq(Source.range(5, 1, -2), system) == [5, 3, 1]
+    calls = []
+
+    def factory():
+        calls.append(True)
+        return iter([1, 2])
+    src = Source.from_iterator(factory)
+    assert run_seq(src, system) == [1, 2]
+    assert run_seq(src, system) == [1, 2]  # fresh iterator per run
+    assert len(calls) == 2
+
+
+def test_actor_ref_with_backpressure(system):
+    from akka_tpu.actor.actor import Actor
+    from akka_tpu.actor.messages import Status
+    from akka_tpu.actor.props import Props
+    from akka_tpu.stream import Materializer
+
+    ref_fut, seq_fut = Source.actor_ref_with_backpressure("ACK") \
+        .to_mat(Sink.seq(), Keep.both).run(Materializer(system))
+    ref = ref_fut.result(5.0)
+
+    acks = []
+
+    class Producer(Actor):
+        def pre_start(self):
+            ref.tell("one", self.self_ref)
+
+        def receive(self, message):
+            if message == "ACK":
+                acks.append(True)
+                if len(acks) == 1:
+                    ref.tell("two", self.self_ref)
+                else:
+                    ref.tell(Status.Success(None), self.self_ref)
+
+    system.actor_of(Props.create(Producer))
+    assert seq_fut.result(5.0) == ["one", "two"]
+    assert len(acks) == 2
+
+
+# -- sinks --------------------------------------------------------------------
+
+def test_foreach_async(system):
+    seen = []
+
+    def fn(x):
+        return later(seen.append(x))
+    fut = Source.from_iterable([1, 2, 3]).run_with(
+        Sink.foreach_async(2, fn), system)
+    fut.result(10.0)
+    assert sorted(seen) == [1, 2, 3]
+
+
+def test_sink_cancelled(system):
+    from akka_tpu.stream import Materializer
+    Source.from_iterable(range(1000)).to(Sink.cancelled()) \
+        .run(Materializer(system))
+    # nothing to assert beyond termination: the stream cancels cleanly
+
+
+def test_lazy_sink_builds_on_first_element(system):
+    built, seen = [], []
+
+    def factory():
+        built.append(True)
+        return Sink.foreach(seen.append)
+
+    Source.from_iterable([1, 2, 3]).to(Sink.lazy_sink(factory)).run(system)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and len(seen) < 3:
+        time.sleep(0.01)
+    assert built == [True]
+    assert seen == [1, 2, 3]
+
+
+def test_lazy_sink_never_builds_without_elements(system):
+    built = []
+
+    def factory():
+        built.append(True)
+        return Sink.ignore()
+
+    Source.empty().to(Sink.lazy_sink(factory)).run(system)
+    time.sleep(0.2)
+    assert built == []
+
+
+def test_future_sink(system):
+    seen = []
+    fut: Future = Future()
+    Source.from_iterable([1, 2]).to(Sink.future_sink(fut)).run(system)
+    time.sleep(0.05)
+    fut.set_result(Sink.foreach(seen.append))
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and len(seen) < 2:
+        time.sleep(0.01)
+    assert seen == [1, 2]
+
+
+# -- lazy flow / from_sink_and_source ----------------------------------------
+
+def test_lazy_flow(system):
+    built = []
+
+    def factory():
+        built.append(True)
+        return Flow().map(lambda x: x * 2)
+
+    out = run_seq(Source.from_iterable([1, 2, 3]).via(
+        Flow.lazy_flow(factory)), system)
+    assert out == [2, 4, 6]  # first element went through the inner flow too
+    assert built == [True]
+
+
+def test_from_sink_and_source(system):
+    seen = []
+    flow = Flow.from_sink_and_source(
+        Sink.foreach(seen.append), Source.from_iterable(["x", "y"]))
+    out = run_seq(Source.from_iterable([1, 2]).via(flow), system)
+    assert out == ["x", "y"]
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline and len(seen) < 2:
+        time.sleep(0.01)
+    assert seen == [1, 2]
+
+
+def test_from_sink_and_source_coupled_cancels_input_side(system):
+    # output side completes -> input side must be torn down too
+    flow = Flow.from_sink_and_source_coupled(
+        Sink.ignore(), Source.from_iterable(["x"]))
+    out = run_seq(Source.tick(0.01, 0.01, 1).via(flow), system, timeout=10.0)
+    assert out == ["x"]
+
+
+def test_pre_materialize(system):
+    from akka_tpu.stream import Materializer
+    mat, src = Source.from_iterable([1, 2, 3]).pre_materialize(
+        Materializer(system))
+    assert run_seq(src, system) == [1, 2, 3]
+
+
+# -- review-hardening cases ---------------------------------------------------
+
+def test_map_async_partitioned_sync_fn(system):
+    # fn returning plain values (allowed) must not corrupt the entry queue
+    out = run_seq(
+        Source.from_iterable(range(6)).map_async_partitioned(
+            2, lambda e: e % 2, lambda e, p: e * 10),
+        system)
+    assert out == [0, 10, 20, 30, 40, 50]
+
+
+def test_source_maybe_downstream_cancel_completes(system):
+    out = run_seq(Source.maybe().take(0), system)
+    assert out == []
+
+
+def test_merge_latest_backpressure_bounded(system):
+    # fast inputs + slow consumer: stream still completes, output bounded
+    out = run_seq(
+        Source.from_iterable(range(50)).merge_latest(
+            Source.from_iterable(range(50))).take(5).delay(0.01),
+        system, timeout=10.0)
+    assert len(out) == 5
+
+
+def test_lazy_sink_materializes_inner_mat(system):
+    from akka_tpu.stream import Materializer
+    fut = Source.from_iterable([1, 2, 3]).to_mat(
+        Sink.lazy_sink(lambda: Sink.seq()), Keep.right) \
+        .run(Materializer(system))
+    inner_mat = fut.result(5.0)          # Future[inner Sink.seq future]
+    assert inner_mat.result(5.0) == [1, 2, 3]
+
+
+def test_lazy_sink_mat_fails_when_never_materialized(system):
+    from akka_tpu.stream import Materializer
+    from akka_tpu.stream.ops4 import NeverMaterializedException
+    fut = Source.empty().to_mat(
+        Sink.lazy_sink(lambda: Sink.seq()), Keep.right) \
+        .run(Materializer(system))
+    assert isinstance(fut.exception(5.0), NeverMaterializedException)
+
+
+def test_actor_ref_with_backpressure_two_senders_no_loss(system):
+    from akka_tpu.actor.actor import Actor
+    from akka_tpu.actor.messages import Status
+    from akka_tpu.actor.props import Props
+    from akka_tpu.stream import Materializer
+
+    ref_fut, seq_fut = Source.actor_ref_with_backpressure("ACK") \
+        .to_mat(Sink.seq(), Keep.both).run(Materializer(system))
+    ref = ref_fut.result(5.0)
+    acked = []
+
+    class P(Actor):
+        def __init__(self, tag):
+            super().__init__()
+            self.tag = tag
+
+        def pre_start(self):
+            ref.tell(self.tag, self.self_ref)
+
+        def receive(self, message):
+            if message == "ACK":
+                acked.append(self.tag)
+
+    system.actor_of(Props.create(P, "a"))
+    system.actor_of(Props.create(P, "b"))
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and len(acked) < 2:
+        time.sleep(0.01)
+    assert sorted(acked) == ["a", "b"]   # neither sender lost its ack
+    ref.tell(Status.Success(None), None)
+    assert sorted(seq_fut.result(5.0)) == ["a", "b"]
