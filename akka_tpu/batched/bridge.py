@@ -22,10 +22,14 @@ Pieces:
   million Python objects unless asked to.
 
 Ask/reply convention: the encoded payload's LAST column carries the reply-to
-row id as a value cast (exact for ids < 2^24 in float32); replying behaviors
-emit to `payload[-1].astype(int32)`. Promise rows run a reduce-kind behavior
-that latches the first reply (pattern/AskSupport.scala:476 parity).
-"""
+row id as a value cast; replying behaviors emit to
+`payload[-1].astype(int32)`. Promise rows run a reduce-kind behavior that
+latches the first reply (pattern/AskSupport.scala:476 parity). The value
+cast is exact only while every row id fits the payload dtype's integer
+range (2^24 for float32, 2^11 for float16, 2^8 for bfloat16) — the handle
+VALIDATES this at construction and refuses capacities whose reply ids
+would silently round (PromiseActorRef identity is never lossy,
+AskSupport.scala:476)."""
 
 from __future__ import annotations
 
@@ -89,6 +93,18 @@ def reply_dst(payload) -> Any:
     return payload[-1].astype(jnp.int32)
 
 
+def max_exact_row_id(dtype) -> int:
+    """Largest row id a value-cast into `dtype` roundtrips exactly.
+
+    Integers: the dtype's max. Floats: every integer up to
+    2^(mantissa_bits + 1) is exactly representable (float32 -> 2^24,
+    float16 -> 2^11, bfloat16 -> 2^8)."""
+    dt = jnp.dtype(dtype)
+    if jnp.issubdtype(dt, jnp.integer):
+        return int(jnp.iinfo(dt).max)
+    return 1 << (jnp.finfo(dt).nmant + 1)
+
+
 def _slice_init(value, idx_or_mask, n_rows: int):
     """Select the per-row slice of an init value: arrays whose leading dim
     matches the spawn's row count are per-row (spawn_block broadcast
@@ -137,6 +153,19 @@ class BatchedRuntimeHandle:
         self.promise_rows_n = promise_rows
         self.auto_step_interval = auto_step_interval
         self.payload_dtype = payload_dtype
+        # ask reply routing rides a VALUE CAST of the reply row id into the
+        # payload dtype's last column (VERDICT r3 #6): refuse, at build
+        # time, any capacity whose ids would round — a bf16 payload system
+        # with 1M rows would otherwise corrupt reply routing silently
+        limit = max_exact_row_id(payload_dtype)
+        if capacity - 1 > limit:
+            raise ValueError(
+                f"capacity {capacity} exceeds the exactly-representable "
+                f"row-id range of payload_dtype "
+                f"{jnp.dtype(payload_dtype).name} (max id {limit}): ask "
+                f"reply ids are value-cast into the last payload column "
+                f"and would silently round — use float32/int32 payloads "
+                f"or capacity <= {limit + 1}")
         self.event_stream = event_stream
         self.flight_recorder = flight_recorder
         if failure_policy not in ("restart", "stop", "suspend"):

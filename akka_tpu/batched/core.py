@@ -480,7 +480,11 @@ class BatchedSystem:
         # FIRST; host rows are cleared) — no per-step concatenate/realloc
         # (VERDICT r1 weak #2)
         out_dst = emits.dst.reshape(-1)
-        out_payload = emits.payload.reshape(-1, self.payload_width)
+        # behaviors may compute emissions in a wider dtype (f32 math on a
+        # bf16 wire): value-cast onto the system payload dtype, the same
+        # contract host tells follow
+        out_payload = emits.payload.reshape(
+            -1, self.payload_width).astype(inbox_payload.dtype)
         out_valid = emits.valid.reshape(-1)
         upd = jax.lax.dynamic_update_slice
         new_inbox_dst = upd(inbox_dst, out_dst, (sc,)).at[sc + nk:].set(-1)

@@ -175,13 +175,41 @@ def _untag(repr_: PersistentRepr) -> Tuple[PersistentRepr, frozenset]:
     return repr_, frozenset()
 
 
+class _SerializedPayload:
+    """Envelope stored in place of the raw event payload when the journal
+    serializes through the Serialization registry: (serializer id,
+    manifest, bytes) — the manifest carries the schema VERSION, so
+    replays after a rolling upgrade run the registered migrations
+    (akka-serialization-jackson JacksonMigration parity)."""
+
+    __slots__ = ("serializer_id", "manifest", "data")
+
+    def __init__(self, serializer_id: int, manifest: str, data: bytes):
+        self.serializer_id = serializer_id
+        self.manifest = manifest
+        self.data = data
+
+    def __getstate__(self):
+        return (self.serializer_id, self.manifest, self.data)
+
+    def __setstate__(self, s):
+        self.serializer_id, self.manifest, self.data = s
+
+
 class FileJournal(JournalPlugin):
     """Append-only record log: one file per persistence id, length-prefixed
     pickled PersistentReprs, plus a tag-index file. Replaces the reference's
     LevelDB store (journal/leveldb/LeveldbStore.scala) with the same
-    capabilities: per-id replay, highest-seq-nr, logical delete-to, tags."""
+    capabilities: per-id replay, highest-seq-nr, logical delete-to, tags.
 
-    def __init__(self, directory: str):
+    With `serialization` set (a serialization.Serialization), event
+    PAYLOADS are stored as (serializer id, manifest, bytes) envelopes via
+    the registry instead of raw pickle — the versioned-manifest seam that
+    makes journals survive schema evolution (VersionedJsonSerializer +
+    SchemaMigration, the Jackson-journal analogue)."""
+
+    def __init__(self, directory: str, serialization=None):
+        self.serialization = serialization
         self.dir = directory
         os.makedirs(directory, exist_ok=True)
         self.lock = threading.RLock()
@@ -252,14 +280,21 @@ class FileJournal(JournalPlugin):
             # all-or-nothing; events reported rejected must not replay later)
             untagged = []
             try:
+                from ..serialization.serialization import SerializationError
                 blobs = []
                 for repr_ in write.payload:
                     r, tags = _untag(repr_)
+                    if self.serialization is not None:
+                        sid, man, blob = self.serialization.serialize(
+                            r.payload)
+                        r = r.with_payload(
+                            _SerializedPayload(sid, man, blob))
                     untagged.append((r, tags))
                     blobs.append(pickle.dumps(r, protocol=4))
                     for t in tags:
                         pickle.dumps((t, 0, r), protocol=4)
-            except (pickle.PickleError, TypeError, AttributeError) as e:
+            except (pickle.PickleError, TypeError, AttributeError,
+                    SerializationError) as e:
                 return f"unserializable event: {e}"  # reject, not fail
             known = pid in self._meta
             m = self._meta.setdefault(pid, {})
@@ -276,10 +311,22 @@ class FileJournal(JournalPlugin):
                 self._append_record(os.path.join(self.dir, "_pids.log"), pid)
             self._save_meta()
             listeners = list(self.listeners)
-        for cb in listeners:
-            for r in stored:
-                cb(r)
+        if listeners:
+            unwrapped = [self._unwrap(r) for r in stored]  # once, not per cb
+            for cb in listeners:
+                for r in unwrapped:
+                    cb(r)
         return None
+
+    def _unwrap(self, r):
+        """Deserialize a _SerializedPayload envelope back into the event
+        object — where versioned manifests run their migrations."""
+        if self.serialization is not None and \
+                isinstance(r.payload, _SerializedPayload):
+            p = r.payload
+            return r.with_payload(self.serialization.deserialize(
+                p.serializer_id, p.manifest, p.data))
+        return r
 
     def replay(self, persistence_id, from_nr, to_nr, max_n, callback):
         if max_n <= 0:
@@ -294,7 +341,7 @@ class FileJournal(JournalPlugin):
                     if len(out) >= max_n:
                         break
         for r in out:
-            callback(r)
+            callback(self._unwrap(r))
 
     def highest_sequence_nr(self, persistence_id, from_nr):
         with self.lock:
@@ -316,7 +363,9 @@ class FileJournal(JournalPlugin):
             for t, off, r in self._read_records(self._tags_path):
                 if t == tag and off > from_offset:
                     out.append((off, r))
-            return out
+        # deserialization (and user migration code) runs OUTSIDE the lock,
+        # like replay(): a slow migration must not stall concurrent writes
+        return [(off, self._unwrap(r)) for off, r in out]
 
     def add_listener(self, listener):
         with self.lock:

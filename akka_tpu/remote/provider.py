@@ -189,6 +189,12 @@ class RemoteActorRefProvider(LocalActorRefProvider):
         self._assoc_lock = threading.Lock()
         self._remote_watcher = None
         self._resend_task = None
+        # per-message wire instrumentation (RemoteInstrument.scala:32):
+        # config entries "module:Class" plus programmatic
+        # provider.remote_instruments.add(...)
+        from .instrument import RemoteInstruments
+        self.remote_instruments = RemoteInstruments.from_config(
+            settings.config.get_list("akka.remote.instruments", []))
 
     # -- bootstrap -----------------------------------------------------------
     def init(self, system) -> None:
@@ -310,11 +316,19 @@ class RemoteActorRefProvider(LocalActorRefProvider):
             is_system=is_system,
             from_address=str(self.local_address), from_uid=self.uid,
             lane=lane)
+        if self.remote_instruments:
+            # serialize-time hook: instruments stamp the reserved header
+            # space (RemoteInstrument.remoteWriteMetadata)
+            env.metadata = self.remote_instruments.write_metadata(
+                ref, message, sender)
         if is_system:
             with assoc.lock:
                 env.seq = next(assoc.seq)
                 assoc.pending_acks[env.seq] = env
         ok = self.transport.send(addr.host, addr.port, env)
+        if ok and self.remote_instruments:
+            self.remote_instruments.message_sent(
+                ref, message, sender, len(env.payload or b""))
         fr = getattr(self, "_flight", None)
         if fr is not None:
             if ok:
@@ -401,6 +415,13 @@ class RemoteActorRefProvider(LocalActorRefProvider):
         recipient = self.resolve_actor_ref(env.recipient)
         sender = (self.resolve_actor_ref(env.sender) if env.sender
                   else self.dead_letters)
+        if self.remote_instruments:
+            # deliver-time hook: same-identifier instruments read back the
+            # metadata stamped on the sending side
+            self.remote_instruments.read_metadata(
+                recipient, message, sender, env.metadata)
+            self.remote_instruments.message_received(
+                recipient, message, sender, len(env.payload or b""))
         if recipient is self.dead_letters:
             # a message (user OR system: Watch must not be lost either) that
             # raced a remote deployment: hand it to the daemon, which buffers

@@ -31,7 +31,10 @@ _LEN = struct.Struct(">I")
 
 _ENV_HEAD = struct.Struct(">HBBiqqq")   # magic, version, flags, sid, uid, seq, ack
 _ENV_MAGIC = 0xAF7A
-_ENV_VERSION = 1
+# version 2 added the flag-bit2 reserved metadata section (RemoteInstrument
+# header space); a v1 peer would misparse the count byte as a string length,
+# so the layout change rides a version bump and v1 frames are still readable
+_ENV_VERSION = 2
 _LANES = ("ordinary", "control", "large")
 
 
@@ -42,10 +45,14 @@ class WireEnvelope:
     the system-message seq/ack channel of SystemMessageDelivery.scala).
 
     Fixed binary layout — NO pickle at the framing layer:
-      >H magic  >B version  >B flags(bit0 is_system, bits4-5 lane)
-      >i serializer_id  >q from_uid  >q seq(-1=None)  >q ack(-1=None)
-      then length-prefixed UTF-8: recipient, sender(flag bit1 = present),
-      manifest, from_address; length-prefixed payload bytes."""
+      >H magic  >B version  >B flags(bit0 is_system, bit2 metadata present,
+      bits4-5 lane)  >i serializer_id  >q from_uid  >q seq(-1=None)
+      >q ack(-1=None); when flag bit2: the RESERVED METADATA SECTION —
+      >B entry count, then per entry >B key >I length + bytes (the
+      RemoteInstrument header space, artery Codecs/EnvelopeBuffer metadata
+      block; keys 1..31 belong to instruments); then length-prefixed
+      UTF-8: recipient, sender(flag bit1 = present), manifest,
+      from_address; length-prefixed payload bytes."""
 
     recipient: str                 # serialization-format path
     sender: Optional[str]
@@ -58,15 +65,23 @@ class WireEnvelope:
     from_address: str = ""
     from_uid: int = 0
     lane: str = "ordinary"         # control | ordinary | large
+    metadata: Optional[Dict[int, bytes]] = None  # instrument key -> bytes
 
     def to_bytes(self) -> bytes:
         flags = (1 if self.is_system else 0) | \
                 (2 if self.sender is not None else 0) | \
+                (4 if self.metadata else 0) | \
                 (_LANES.index(self.lane) << 4)
         parts = [_ENV_HEAD.pack(
             _ENV_MAGIC, _ENV_VERSION, flags, self.serializer_id,
             self.from_uid, -1 if self.seq is None else self.seq,
             -1 if self.ack is None else self.ack)]
+        if self.metadata:
+            parts.append(struct.pack(">B", len(self.metadata)))
+            for key, blob in sorted(self.metadata.items()):
+                parts.append(struct.pack(">B", key))
+                parts.append(_LEN.pack(len(blob)))
+                parts.append(blob)
         for s in (self.recipient, self.sender or "", self.manifest,
                   self.from_address):
             b = s.encode("utf-8")
@@ -81,9 +96,21 @@ class WireEnvelope:
         magic, version, flags, sid, uid, seq, ack = _ENV_HEAD.unpack_from(data, 0)
         if magic != _ENV_MAGIC:
             raise ValueError(f"bad envelope magic 0x{magic:04x}")
-        if version != _ENV_VERSION:
+        if not 1 <= version <= _ENV_VERSION:
             raise ValueError(f"unsupported envelope version {version}")
         off = _ENV_HEAD.size
+        metadata = None
+        if version >= 2 and flags & 4:
+            (count,) = struct.unpack_from(">B", data, off)
+            off += 1
+            metadata = {}
+            for _ in range(count):
+                (key,) = struct.unpack_from(">B", data, off)
+                off += 1
+                (n,) = _LEN.unpack_from(data, off)
+                off += 4
+                metadata[key] = data[off:off + n]
+                off += n
         strings = []
         for _ in range(4):
             (n,) = _LEN.unpack_from(data, off)
@@ -104,7 +131,8 @@ class WireEnvelope:
             seq=None if seq < 0 else seq,
             ack=None if ack < 0 else ack,
             from_address=from_address, from_uid=uid,
-            lane=_LANES[(flags >> 4) & 3])
+            lane=_LANES[(flags >> 4) & 3],
+            metadata=metadata)
 
 
 InboundHandler = Callable[[WireEnvelope], None]

@@ -1,0 +1,16 @@
+"""Serialization registry, fixed-schema wire codec, and schema-evolution
+(versioned manifests + migrations) serializers. See serialization.py and
+versioned.py for the reference mapping."""
+
+from .serialization import (JsonSerializer, PickleSerializer,  # noqa: F401
+                            SerializationError, Serialization, Serializer,
+                            StringSerializer, TensorSerializer,
+                            transport_information)
+from .versioned import SchemaMigration, VersionedJsonSerializer  # noqa: F401
+
+__all__ = [
+    "Serialization", "Serializer", "SerializationError",
+    "PickleSerializer", "StringSerializer", "JsonSerializer",
+    "TensorSerializer", "transport_information",
+    "SchemaMigration", "VersionedJsonSerializer",
+]

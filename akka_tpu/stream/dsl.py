@@ -769,13 +769,23 @@ class Flow:
             ks = KillSwitches.shared("coupled")
             watched = Flow().via(ks.flow).watch_termination()  # .flow is a property
 
+            def couple(f):
+                # a FAILED side aborts the other with the error; a clean
+                # completion shuts it down (CoupledTerminationFlow
+                # propagates failure, not completion)
+                ex = f.exception()
+                if ex is not None:
+                    ks.abort(ex)
+                else:
+                    ks.shutdown()
+
             o1, fut1 = watched._build(b, upstream)
             m1 = sink_build(b, o1)
-            fut1.add_done_callback(lambda _f: ks.shutdown())
+            fut1.add_done_callback(couple)
 
             o2, m2 = src_build(b)
             o3, fut2 = watched._build(b, o2)
-            fut2.add_done_callback(lambda _f: ks.shutdown())
+            fut2.add_done_callback(couple)
             return o3, (m1, m2)
         return Flow(build)
 
