@@ -103,7 +103,11 @@ class ShardedBatchedSystem:
                 jnp.full((n,), -1, self.state_spec["_become"][1]), shard)
         self.behavior_id = jax.device_put(jnp.zeros((n,), jnp.int32), shard)
         self.alive = jax.device_put(jnp.zeros((n,), jnp.bool_), shard)
-        self.step_count = jnp.asarray(0, jnp.int32)
+        # committed + replicated on the mesh from the start: an uncommitted
+        # scalar would change sharding after the first step and force a
+        # SECOND full compile (observed: 2x ~2s at tiny sizes on CPU)
+        self.step_count = jax.device_put(
+            jnp.asarray(0, jnp.int32), NamedSharding(self.mesh, P()))
 
         # inbox per shard: spill slots first (older mail outranks fresh in
         # the stable delivery sort), then D*C exchange slots, then host slots
@@ -278,8 +282,18 @@ class ShardedBatchedSystem:
             carry, _ = jax.lax.scan(body, carry, None, length=n_steps)
             return carry
 
+        # pin output shardings to the INPUT shardings: without this, GSPMD
+        # may normalize an output (observed: inbox_payload -> replicated on
+        # a 1-device mesh), the carry's sharding then differs from the
+        # first compile's inputs, and every run after the first recompiles
+        shard_s = NamedSharding(mesh, P(axis))
+        repl_s = NamedSharding(mesh, P())
+        out_shardings = ({k: shard_s for k in self.state_spec},
+                         shard_s, shard_s, shard_s, shard_s, shard_s,
+                         shard_s, shard_s, shard_s, repl_s)
         return jax.jit(multi_step, static_argnums=(11,),
-                       donate_argnums=tuple(range(9)))
+                       donate_argnums=tuple(range(9)),
+                       out_shardings=out_shardings)
 
     # ------------------------------------------------------------- lifecycle
     def spawn_block(self, behavior: BatchedBehavior | int, n: int,

@@ -97,7 +97,16 @@ class PhiAccrualFailureDetector(FailureDetector):
             if self._last_timestamp is not None:
                 interval = now - self._last_timestamp
                 if self.is_available_at(now):
-                    self._history.add(interval)
+                    # winsorize the admitted sample: a scheduling stall that
+                    # slips under a generous acceptable-pause would otherwise
+                    # enter the history at full size, inflate the std
+                    # deviation, admit even LARGER stalls, and run away
+                    # until phi can never cross the threshold (observed on a
+                    # loaded single-core host: 180s of real silence went
+                    # undetected). Capping at mean+pause keeps the estimator
+                    # adaptive without the unbounded ratchet.
+                    cap = self._history.mean + self.acceptable_heartbeat_pause
+                    self._history.add(min(interval, cap))
             self._last_timestamp = now
 
     def phi(self, at: Optional[float] = None) -> float:

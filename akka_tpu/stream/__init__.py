@@ -24,6 +24,7 @@ from .hub import BroadcastHub, MergeHub  # noqa: F401
 from .device import DevicePipeline  # noqa: F401
 from .streamref import SinkRef, SourceRef, StreamRefs  # noqa: F401
 from .attributes import Attributes, Supervision  # noqa: F401
+from .context import FlowWithContext, SourceWithContext  # noqa: F401
 from .restart import (RestartFlow, RestartSettings, RestartSink,  # noqa: F401
                       RestartSource)
 from .ops import _QUEUE_END as QUEUE_END  # noqa: F401
@@ -43,4 +44,5 @@ __all__ = [
     "StreamRefs", "SourceRef", "SinkRef",
     "Attributes", "Supervision",
     "RestartSource", "RestartFlow", "RestartSink", "RestartSettings",
+    "SourceWithContext", "FlowWithContext",
 ]

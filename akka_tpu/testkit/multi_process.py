@@ -123,7 +123,11 @@ def spawn_nodes(worker_source: str, n_nodes: int,
     """Run `worker_source` in n real processes. The source sees
     AKKA_TPU_NODE_INDEX / AKKA_TPU_NODE_COUNT / AKKA_TPU_CONDUCTOR_PORT
     and uses node_barrier()/node_result(). Returns (results, stderrs).
-    Raises on nonzero exit or timeout (with stderr attached)."""
+    Raises on nonzero exit or timeout (with stderr attached). The overall
+    timeout dilates with machine load (testkit.dilation) — n extra python
+    processes on a busy box legitimately take longer to reach barriers."""
+    from .dilation import dilated
+    timeout = dilated(timeout)
     conductor = Conductor(n_nodes)
     procs: List[subprocess.Popen] = []
     drains: List[threading.Thread] = []

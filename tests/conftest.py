@@ -20,3 +20,19 @@ jax.config.update("jax_platforms", "cpu")
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running scale tests")
+    config.addinivalue_line(
+        "markers",
+        "timing: wall-clock-coupled suites (lease TTLs, heartbeats, SBR "
+        "stable-after). Deadlines auto-dilate with machine load "
+        "(akka_tpu.testkit.dilation; override with "
+        "AKKA_TPU_TEST_TIMEFACTOR). Run these WITHOUT pytest-xdist "
+        "parallelism; they tolerate background load via dilation but "
+        "sharing one core pool with other timing suites multiplies "
+        "variance.")
+
+
+def pytest_report_header(config):
+    from akka_tpu.testkit.dilation import time_factor
+    return (f"akka-tpu timing dilation: factor={time_factor():.2f} "
+            f"(load={os.getloadavg()[0]:.1f}/{os.cpu_count()} cpus; "
+            f"override: AKKA_TPU_TEST_TIMEFACTOR)")

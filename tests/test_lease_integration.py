@@ -17,25 +17,44 @@ from akka_tpu.cluster_tools.lease import (FileLease, InProcLease,
                                           LeaseSettings, TimeoutSettings)
 from akka_tpu.remote.transport import InProcTransport
 from akka_tpu.testkit import await_condition
+from akka_tpu.testkit.dilation import dilated, dilated_s
 
-LEASE_FAST = {"akka": {"actor": {"provider": "cluster"},
-                       "stdout-loglevel": "OFF", "log-dead-letters": 0,
-                       "remote": {"transport": "inproc",
-                                  "canonical": {"hostname": "local",
-                                                "port": 0}},
-                       "cluster": {"gossip-interval": "0.05s",
-                                   "leader-actions-interval": "0.05s",
-                                   "unreachable-nodes-reaper-interval": "0.1s",
-                                   "failure-detector": {
-                                       "heartbeat-interval": "0.1s",
-                                       "acceptable-heartbeat-pause": "2s"},
-                                   "split-brain-resolver": {
-                                       "active-strategy": "lease-majority",
-                                       "stable-after": "1s",
-                                       "lease-majority": {
-                                           "lease-name": "sbr-test-lease",
-                                           "lease-implementation": "in-proc",
-                                           "heartbeat-timeout": "2s"}}}}}
+
+def _lease_fast():
+    """Timing config with load-adaptive deadlines (TestKit `dilated`
+    discipline, TestKit.scala:244-319): the windows a STARVED thread can
+    blow — heartbeat pauses, lease TTLs, SBR stable-after — widen with
+    machine load; the cadence values (gossip/heartbeat intervals) stay
+    fast so tests don't slow down when the box is quiet."""
+    return {"akka": {"actor": {"provider": "cluster"},
+                     "stdout-loglevel": "OFF", "log-dead-letters": 0,
+                     "remote": {"transport": "inproc",
+                                "canonical": {"hostname": "local",
+                                              "port": 0}},
+                     "cluster": {"gossip-interval": "0.05s",
+                                 "leader-actions-interval": "0.05s",
+                                 "unreachable-nodes-reaper-interval": "0.1s",
+                                 "failure-detector": {
+                                     "heartbeat-interval": "0.1s",
+                                     "acceptable-heartbeat-pause":
+                                         dilated_s(2.0)},
+                                 "split-brain-resolver": {
+                                     "active-strategy": "lease-majority",
+                                     "stable-after": dilated_s(1.0),
+                                     "lease-majority": {
+                                         "lease-name": "sbr-test-lease",
+                                         "lease-implementation": "in-proc",
+                                         "heartbeat-timeout":
+                                             dilated_s(2.0),
+                                         # must scale WITH the dilated
+                                         # stable-after: a fixed 2s head
+                                         # start loses to a majority
+                                         # decider starved >2s under load
+                                         "acquire-lease-delay-for-minority":
+                                             dilated(2.0)}}}}}
+
+
+LEASE_FAST = _lease_fast()
 
 
 def _up_count(cluster):
@@ -47,7 +66,7 @@ def _up_count(cluster):
 def lease_cluster():
     InProcTransport.fault_injector.reset()
     InProcLease.reset_all()
-    systems = [ActorSystem.create(f"lc{i}", LEASE_FAST) for i in range(3)]
+    systems = [ActorSystem.create(f"lc{i}", _lease_fast()) for i in range(3)]
     clusters = [Cluster.get(s) for s in systems]
     yield systems, clusters
     for s in systems:
@@ -125,7 +144,7 @@ def test_lease_majority_sbr_resolves_partition(lease_cluster):
     for c in clusters:
         c.join(first)
     await_condition(lambda: all(_up_count(c) == 3 for c in clusters),
-                    max_time=10.0, message="cluster did not form")
+                    max_time=dilated(10.0), message="cluster did not form")
 
     addrs = [f"local:{s.provider.local_address.port}" for s in systems]
     fi = InProcTransport.fault_injector
@@ -137,10 +156,10 @@ def test_lease_majority_sbr_resolves_partition(lease_cluster):
     # majority side (holds the lease first): stays at 2; minority: downs self
     await_condition(lambda: all(len(c.state.members) == 2
                                 for c in clusters[:2]),
-                    max_time=25.0,
+                    max_time=dilated(25.0),
                     message=f"majority never pruned: "
                             f"{[c.state for c in clusters[:2]]}")
-    assert clusters[2].await_removed(25.0), "minority never downed itself"
+    assert clusters[2].await_removed(dilated(25.0)), "minority never downed itself"
 
 
 # -- join config compatibility ------------------------------------------------
@@ -163,11 +182,11 @@ def test_incompatible_config_refused_on_join():
         seed = str(a.provider.local_address)
         Cluster.get(a).join(seed)
         await_condition(lambda: _up_count(Cluster.get(a)) == 1,
-                        max_time=10.0, message="seed did not form")
+                        max_time=dilated(10.0), message="seed did not form")
         Cluster.get(b).join(seed)
         await_condition(
             lambda: Cluster.get(b).join_refused_reason is not None,
-            max_time=10.0, message="join never refused")
+            max_time=dilated(10.0), message="join never refused")
         assert "incompatible" in Cluster.get(b).join_refused_reason
         assert any("refused" in w for w in warnings)
         assert _up_count(Cluster.get(a)) == 1  # never admitted
@@ -189,7 +208,7 @@ def test_compatible_config_still_joins():
         await_condition(
             lambda: _up_count(Cluster.get(a)) == 2
             and _up_count(Cluster.get(b)) == 2,
-            max_time=10.0, message="same-config nodes failed to join")
+            max_time=dilated(10.0), message="same-config nodes failed to join")
     finally:
         for s in (b, a):
             s.terminate()
@@ -219,13 +238,13 @@ def test_singleton_waits_for_lease():
     # an external contender holds the lease first
     blocker = InProcLease(LeaseSettings(
         "single-singleton-one", "blocker",
-        TimeoutSettings(heartbeat_interval=0.1, heartbeat_timeout=1.0)))
+        TimeoutSettings(heartbeat_interval=0.1, heartbeat_timeout=dilated(1.0))))
     assert blocker.acquire()
 
-    s = ActorSystem.create("single", LEASE_FAST)
+    s = ActorSystem.create("single", _lease_fast())
     try:
         Cluster.get(s).join(str(s.provider.local_address))
-        await_condition(lambda: _up_count(Cluster.get(s)) == 1, max_time=10.0)
+        await_condition(lambda: _up_count(Cluster.get(s)) == 1, max_time=dilated(10.0))
         s.actor_of(Props.create(
             ClusterSingletonManager, Props.create(TheOne),
             ClusterSingletonSettings(singleton_name="one", use_lease=True,
@@ -234,7 +253,7 @@ def test_singleton_waits_for_lease():
         time.sleep(1.0)
         assert started == []  # lease held elsewhere: must NOT start
         blocker.release()
-        await_condition(lambda: len(started) == 1, max_time=10.0,
+        await_condition(lambda: len(started) == 1, max_time=dilated(10.0),
                         message="singleton never started after release")
     finally:
         s.terminate()
@@ -252,20 +271,20 @@ def test_sbr_releases_lease_after_resolution(lease_cluster):
     for c in clusters:
         c.join(first)
     await_condition(lambda: all(_up_count(c) == 3 for c in clusters),
-                    max_time=10.0, message="cluster did not form")
+                    max_time=dilated(10.0), message="cluster did not form")
     addrs = [f"local:{s.provider.local_address.port}" for s in systems]
     fi = InProcTransport.fault_injector
     for i in (0, 1):
         fi.blackhole(addrs[i], addrs[2])
         fi.blackhole(addrs[2], addrs[i])
     await_condition(lambda: all(len(c.state.members) == 2
-                                for c in clusters[:2]), max_time=25.0)
+                                for c in clusters[:2]), max_time=dilated(25.0))
     # after the release window (2*stable_after + 2s), an outside owner can
     # take the lease — proof the winner let go
     probe = InProcLease(LeaseSettings(
         "sbr-test-lease", "probe",
         TimeoutSettings(heartbeat_interval=10.0, heartbeat_timeout=2.0)))
-    await_condition(probe.acquire, max_time=15.0,
+    await_condition(probe.acquire, max_time=dilated(15.0),
                     message="SBR lease never released after resolution")
     probe.release()
 
@@ -292,16 +311,16 @@ def test_singleton_steps_down_on_lease_loss():
         def receive(self, message):
             pass
 
-    s = ActorSystem.create("stepdown", LEASE_FAST)
+    s = ActorSystem.create("stepdown", _lease_fast())
     try:
         Cluster.get(s).join(str(s.provider.local_address))
-        await_condition(lambda: _up_count(Cluster.get(s)) == 1, max_time=10.0)
+        await_condition(lambda: _up_count(Cluster.get(s)) == 1, max_time=dilated(10.0))
         s.actor_of(Props.create(
             ClusterSingletonManager, Props.create(TheOne),
             ClusterSingletonSettings(singleton_name="sd", use_lease=True,
                                      lease_name="stepdown-lease")),
             "sd-manager")
-        await_condition(lambda: len(alive) == 1, max_time=10.0,
+        await_condition(lambda: len(alive) == 1, max_time=dilated(10.0),
                         message="singleton never started")
         # simulate a stalled holder: expire the record, let a rival take it
         with InProcLease._lock:
@@ -310,11 +329,11 @@ def test_singleton_steps_down_on_lease_loss():
             "stepdown-lease", "rival",
             TimeoutSettings(heartbeat_interval=0.2, heartbeat_timeout=30.0)))
         assert rival.acquire()
-        await_condition(lambda: len(alive) == 0, max_time=10.0,
+        await_condition(lambda: len(alive) == 0, max_time=dilated(10.0),
                         message="singleton kept running without the lease")
         # rival lets go: the manager re-acquires and restarts the instance
         rival.release()
-        await_condition(lambda: len(alive) == 1, max_time=10.0,
+        await_condition(lambda: len(alive) == 1, max_time=dilated(10.0),
                         message="singleton never came back")
     finally:
         s.terminate()
@@ -336,7 +355,7 @@ def test_device_rebalance_requires_lease():
         return {"n": state["n"] + inbox.count}, Emit.none(1, 4)
 
     InProcLease.reset_all()
-    t = TimeoutSettings(heartbeat_interval=0.1, heartbeat_timeout=1.0)
+    t = TimeoutSettings(heartbeat_interval=0.1, heartbeat_timeout=dilated(1.0))
     coordinator_lease = InProcLease(LeaseSettings("shard-coord", "region", t))
     region = DeviceShardRegion(DeviceEntity(
         "lease-ent", ent, n_shards=4, entities_per_shard=4,

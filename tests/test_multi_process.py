@@ -19,6 +19,7 @@ _COMMON = r"""
 import json, os, sys, time
 from akka_tpu import ActorSystem
 from akka_tpu.cluster import Cluster
+from akka_tpu.testkit.dilation import dilated, dilated_s
 from akka_tpu.testkit.multi_process import (node_barrier, node_index,
                                             node_count, node_result)
 
@@ -27,6 +28,9 @@ N = node_count()
 BASE_PORT = int(os.environ["AKKA_TPU_TEST_BASE_PORT"])
 
 def make_system(extra=None):
+    # starvation windows (heartbeat pause, SBR timing overridden by tests)
+    # auto-dilate with machine load (TestKit.scala:244-319 `dilated`
+    # discipline): N extra busy processes must widen deadlines, not flake
     cfg = {"akka": {"actor": {"provider": "cluster"},
                     "stdout-loglevel": "OFF", "log-dead-letters": 0,
                     "remote": {"transport": "tcp",
@@ -37,7 +41,8 @@ def make_system(extra=None):
                                 "unreachable-nodes-reaper-interval": "0.2s",
                                 "failure-detector": {
                                     "heartbeat-interval": "0.2s",
-                                    "acceptable-heartbeat-pause": "2s"}}}}
+                                    "acceptable-heartbeat-pause":
+                                        dilated_s(2.0)}}}}
     if extra:
         def deep(dst, src):
             for k, v in src.items():
@@ -53,7 +58,7 @@ def up_count(system):
                 if m.status.value == "Up"])
 
 def await_(cond, secs, what):
-    deadline = time.monotonic() + secs
+    deadline = time.monotonic() + dilated(secs)
     while time.monotonic() < deadline:
         if cond():
             return
@@ -87,8 +92,8 @@ def test_three_process_partition_sbr_downs_minority():
     worker = _COMMON + r"""
 system = make_system({"akka": {"cluster": {
     "split-brain-resolver": {"active-strategy": "keep-majority",
-                             "stable-after": "1s"},
-    "down-removal-margin": "0.5s"}}})
+                             "stable-after": dilated_s(1.0)},
+    "down-removal-margin": dilated_s(0.5)}}})
 seed = f"akka://mp0@127.0.0.1:{BASE_PORT}"
 node_barrier("boot")
 Cluster.get(system).join(seed)
@@ -134,12 +139,14 @@ FileLease.directory = os.environ["AKKA_TPU_TEST_LEASE_DIR"]
 system = make_system({"akka": {"cluster": {
     "split-brain-resolver": {
         "active-strategy": "lease-majority",
-        "stable-after": "1s",
+        "stable-after": dilated_s(1.0),
         "lease-majority": {"lease-name": "mp-sbr",
                            "lease-implementation": "file",
                            "heartbeat-interval": "0.3s",
-                           "heartbeat-timeout": "3s"}},
-    "down-removal-margin": "0.5s"}}})
+                           "heartbeat-timeout": dilated_s(3.0),
+                           "acquire-lease-delay-for-minority":
+                               dilated(2.0)}},
+    "down-removal-margin": dilated_s(0.5)}}})
 seed = f"akka://mp0@127.0.0.1:{BASE_PORT}"
 node_barrier("boot")
 Cluster.get(system).join(seed)

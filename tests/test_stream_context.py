@@ -1,0 +1,101 @@
+"""SourceWithContext / FlowWithContext — modeled on the reference's
+FlowWithContextSpec / SourceWithContextSpec (akka-stream-tests): the
+context follows data through map/mapAsync, drops with filter/collect,
+duplicates through mapConcat, and collects through grouped."""
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from akka_tpu import ActorSystem
+from akka_tpu.stream import (Flow, FlowWithContext, Sink, Source,
+                             SourceWithContext)
+
+CFG = {"akka": {"stdout-loglevel": "OFF", "log-dead-letters": 0}}
+POOL = ThreadPoolExecutor(2)
+
+
+@pytest.fixture(scope="module")
+def system():
+    s = ActorSystem.create("stream-context-test", CFG)
+    yield s
+    s.terminate()
+    s.await_termination(10.0)
+
+
+def run_pairs(swc, system, timeout=5.0):
+    return swc.run_with(Sink.seq(), system).result(timeout)
+
+
+def offsets(records):
+    """A Kafka-like feed: (value, offset) with offset as context."""
+    return Source.from_iterable(list(enumerate(records))) \
+        .as_source_with_context(lambda p: p[0]).map(lambda p: p[1])
+
+
+def test_context_follows_map_and_filter(system):
+    out = run_pairs(
+        offsets(["a", "b", "skip", "d"])
+        .map(str.upper)
+        .filter(lambda v: v != "SKIP"),
+        system)
+    assert out == [("A", 0), ("B", 1), ("D", 3)]  # offset 2 dropped WITH b
+
+
+def test_map_concat_duplicates_context(system):
+    out = run_pairs(
+        offsets(["xy", "z"]).map_concat(list), system)
+    assert out == [("x", 0), ("y", 0), ("z", 1)]
+
+
+def test_grouped_collects_contexts(system):
+    out = run_pairs(offsets(["a", "b", "c"]).grouped(2), system)
+    assert out == [(["a", "b"], [0, 1]), (["c"], [2])]
+
+
+def test_map_async_preserves_context_order(system):
+    def slow_upper(v):
+        def work():
+            time.sleep(0.01 if v == "a" else 0.001)
+            return v.upper()
+        return POOL.submit(work)
+
+    out = run_pairs(offsets(["a", "b", "c"]).map_async(3, slow_upper),
+                    system, timeout=10.0)
+    assert out == [("A", 0), ("B", 1), ("C", 2)]
+
+
+def test_map_context_and_collect(system):
+    out = run_pairs(
+        offsets(["a", "b"]).map_context(lambda off: ("part0", off))
+        .collect(lambda v: v * 2 if v == "b" else None),
+        system)
+    assert out == [("bb", ("part0", 1))]
+
+
+def test_via_flow_with_context_and_as_flow(system):
+    fwc = FlowWithContext.create().map(lambda x: x + 1) \
+        .filter(lambda x: x % 2 == 0)
+    out = run_pairs(
+        SourceWithContext.from_tuples(
+            Source.from_iterable([(1, "c1"), (2, "c2"), (3, "c3")])).via(fwc),
+        system)
+    assert out == [(2, "c1"), (4, "c3")]
+    # as_flow unwraps to a plain Flow of pairs
+    plain = Source.from_iterable([(5, "k")]).via(fwc.as_flow()) \
+        .run_with(Sink.seq(), system).result(5.0)
+    assert plain == [(6, "k")]
+
+
+def test_flow_as_flow_with_context(system):
+    # adapt a PLAIN Flow: collapse (data, ctx) -> input, re-extract ctx
+    inner = Flow().map(lambda s: s + "!")
+    fwc = inner.as_flow_with_context(
+        lambda data, ctx: f"{ctx}:{data}",
+        lambda out: out.split(":", 1)[0])
+    out = run_pairs(
+        SourceWithContext.from_tuples(
+            Source.from_iterable([("hi", "k1"), ("yo", "k2")])).via(fwc),
+        system)
+    assert out == [("k1:hi!", "k1"), ("k2:yo!", "k2")]
