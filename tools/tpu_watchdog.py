@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""TPU-hunting watchdog (VERDICT r4 next-round #1).
+
+Three consecutive rounds produced zero TPU numbers because the backend was
+probed exactly once, at end-of-round, against a tunnel that hangs rather
+than errors. This watchdog inverts the strategy: probe the default backend
+in a throwaway subprocess every --interval seconds for the WHOLE round,
+appending {ts, ok, detail, probe_s} to TPU_PROBELOG.jsonl (committed, so a
+round with no TPU evidence at least carries proof the tunnel never once
+yielded). The FIRST successful probe immediately runs the full measurement
+surface on-chip and commits the artifacts:
+
+  1. python bench.py --full            -> BENCH_TPU.json (last JSON line)
+  2. tools/attrib_dynamic.py --json    -> docs/attrib_tpu.json
+  3. bench.py --config ring-dynamic --trace traces/tpu_r05 (profiler trace)
+
+Run detached:  nohup python tools/tpu_watchdog.py >> watchdog.log 2>&1 &
+
+The reference analogue of the numbers this exists to capture is
+MaxThroughputSpec printing msg/s at run time
+(akka-remote-tests/.../artery/MaxThroughputSpec.scala:253) against the
+Mailbox hot loop (akka-actor/.../dispatch/Mailbox.scala:260-277).
+"""
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "TPU_PROBELOG.jsonl")
+PROBE_SRC = ("import jax; d = jax.devices(); "
+             "print(d[0].platform, d[0].device_kind, len(d))")
+
+
+def _utcnow() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
+
+
+def probe(timeout_s: float) -> tuple[bool, str]:
+    """jax.devices() in a throwaway subprocess with a hard timeout.
+
+    The wedged axon tunnel HANGS in-process (observed >540s), so the probe
+    must be out-of-process and killable. JAX_PLATFORMS is stripped so the
+    ambient sitecustomize platform (the tunnel) is what gets probed.
+    """
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    try:
+        r = subprocess.run([sys.executable, "-c", PROBE_SRC],
+                           timeout=timeout_s, capture_output=True,
+                           text=True, env=env, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {timeout_s:.0f}s"
+    if r.returncode != 0:
+        tail = (r.stderr.strip().splitlines() or ["unknown"])[-1][:300]
+        return False, f"rc={r.returncode}: {tail}"
+    detail = r.stdout.strip()
+    ok = bool(detail) and not detail.lower().startswith(("cpu", "host"))
+    return ok, detail or "empty probe output"
+
+
+def append_log(rec: dict) -> None:
+    with open(LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def run_logged(name: str, cmd: list[str], timeout_s: float) -> bool:
+    t0 = time.time()
+    print(f"[watchdog] {name}: {' '.join(cmd)}", flush=True)
+    try:
+        r = subprocess.run(cmd, cwd=REPO, timeout=timeout_s,
+                           capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        append_log({"ts": _utcnow(), "ok": False,
+                    "detail": f"{name} timed out after {timeout_s:.0f}s"})
+        return False
+    out_path = os.path.join(REPO, f"watchdog_{name}.out")
+    with open(out_path, "w") as f:
+        f.write(r.stdout)
+        f.write("\n--- stderr ---\n")
+        f.write(r.stderr)
+    append_log({"ts": _utcnow(), "ok": r.returncode == 0,
+                "detail": f"{name} rc={r.returncode} "
+                          f"({time.time() - t0:.0f}s)"})
+    return r.returncode == 0
+
+
+def git_commit(paths: list[str], msg: str) -> None:
+    """Commit artifacts; retry briefly if the builder session holds the
+    index (both sides commit fast, so contention clears in seconds)."""
+    for attempt in range(5):
+        subprocess.run(["git", "add", "-f", *paths], cwd=REPO,
+                       capture_output=True)
+        r = subprocess.run(["git", "commit", "-m", msg], cwd=REPO,
+                           capture_output=True, text=True)
+        if r.returncode == 0 or "nothing to commit" in r.stdout:
+            return
+        time.sleep(3.0 * (attempt + 1))
+
+
+def on_tpu_found(detail: str) -> None:
+    """First successful probe: run the full surface on-chip, commit it."""
+    bench_out = os.path.join(REPO, "watchdog_bench_full.out")
+    ok = run_logged(
+        "bench_full",
+        [sys.executable, "bench.py", "--full", "--probe-timeout", "120",
+         "--probe-attempts", "3", "--budget", "2400"],
+        timeout_s=3600)
+    # last JSON line of stdout -> BENCH_TPU.json
+    last = None
+    if os.path.exists(bench_out):
+        for line in open(bench_out):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    last = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
+    if last is not None:
+        with open(os.path.join(REPO, "BENCH_TPU.json"), "w") as f:
+            json.dump(last, f, indent=1)
+    run_logged("attrib", [sys.executable, "tools/attrib_dynamic.py",
+                          "--actors", str(1 << 20), "--json"],
+               timeout_s=1800)
+    attrib_out = os.path.join(REPO, "watchdog_attrib.out")
+    run_logged("trace", [sys.executable, "bench.py", "--config",
+                         "ring-dynamic", "--trace", "traces/tpu_r05",
+                         "--probe-timeout", "120"],
+               timeout_s=1800)
+    paths = [LOG, "watchdog_bench_full.out", "watchdog_attrib.out",
+             "watchdog_trace.out"]
+    if last is not None:
+        paths.append("BENCH_TPU.json")
+    if os.path.isdir(os.path.join(REPO, "traces/tpu_r05")):
+        paths.append("traces/tpu_r05")
+    git_commit(paths, "TPU watchdog: on-chip bench surface "
+                      f"({detail}; full={'ok' if ok else 'partial'})")
+
+
+def main() -> None:
+    interval = float(os.environ.get("TPU_PROBE_INTERVAL", "600"))
+    timeout = float(os.environ.get("TPU_PROBE_TIMEOUT", "90"))
+    print(f"[watchdog] start interval={interval}s timeout={timeout}s",
+          flush=True)
+    while True:
+        t0 = time.time()
+        ok, detail = probe(timeout)
+        append_log({"ts": _utcnow(), "ok": ok, "detail": detail,
+                    "probe_s": round(time.time() - t0, 1)})
+        print(f"[watchdog] probe ok={ok} detail={detail}", flush=True)
+        if ok:
+            on_tpu_found(detail)
+            print("[watchdog] TPU surface captured; exiting", flush=True)
+            return
+        time.sleep(interval)
+
+
+if __name__ == "__main__":
+    main()
