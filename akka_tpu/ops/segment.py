@@ -18,6 +18,21 @@ import jax
 import jax.numpy as jnp
 
 
+def _resolve_platform(x: jax.Array) -> str:
+    """Platform the computation will actually RUN on, for auto-mode kernel
+    choice: prefer the operand's committed device (arrays placed on TPU
+    while the process default is cpu — the repo's own cpu-first forcing
+    workflow — must still pick the TPU kernel); tracers carry no devices,
+    so fall back to the default backend that jit will target."""
+    try:
+        devs = x.devices()
+        if devs:
+            return next(iter(devs)).platform
+    except Exception:  # noqa: BLE001 — tracers/abstract values
+        pass
+    return jax.default_backend()
+
+
 class Delivery(NamedTuple):
     sum: jax.Array     # [N, P]
     max: jax.Array     # [N, P]
@@ -51,7 +66,7 @@ def deliver(dst: jax.Array, payload: jax.Array, valid: jax.Array,
       where sorts vectorize and unsorted scatters serialize.
     """
     if mode == "auto":
-        if dst.shape[0] <= 1024 or jax.default_backend() == "cpu":
+        if dst.shape[0] <= 1024 or _resolve_platform(dst) == "cpu":
             mode = "scatter"
         else:
             mode = "merge"

@@ -72,8 +72,13 @@ class WireEnvelope:
                 (2 if self.sender is not None else 0) | \
                 (4 if self.metadata else 0) | \
                 (_LANES.index(self.lane) << 4)
+        # the v1 and v2 layouts are identical when flag bit2 is clear, so
+        # metadata-free frames are stamped v1 — a rolling upgrade keeps
+        # working in BOTH directions until an instrument actually writes
+        # metadata (the v2 stamp is reserved for frames that carry it)
+        version = _ENV_VERSION if self.metadata else 1
         parts = [_ENV_HEAD.pack(
-            _ENV_MAGIC, _ENV_VERSION, flags, self.serializer_id,
+            _ENV_MAGIC, version, flags, self.serializer_id,
             self.from_uid, -1 if self.seq is None else self.seq,
             -1 if self.ack is None else self.ack)]
         if self.metadata:

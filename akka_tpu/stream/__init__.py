@@ -20,7 +20,9 @@ from .ops import (BufferOverflowException, NoSuchElementException,  # noqa: F401
                   SinkQueue, SourceQueue, TickCancellable)
 from .killswitch import (KillSwitches, SharedKillSwitch,  # noqa: F401
                          UniqueKillSwitch)
-from .hub import BroadcastHub, MergeHub  # noqa: F401
+from .hub import BroadcastHub, ConsumerInfo, MergeHub, PartitionHub  # noqa: F401
+from .framing import Framing, FramingException, JsonFraming  # noqa: F401
+from .retry import RetryFlow  # noqa: F401
 from .device import DevicePipeline  # noqa: F401
 from .streamref import SinkRef, SourceRef, StreamRefs  # noqa: F401
 from .attributes import Attributes, Supervision  # noqa: F401
@@ -40,7 +42,9 @@ __all__ = [
     "SourceQueue", "SinkQueue", "QUEUE_END", "TickCancellable",
     "NoSuchElementException", "BufferOverflowException",
     "KillSwitches", "UniqueKillSwitch", "SharedKillSwitch",
-    "MergeHub", "BroadcastHub", "DevicePipeline",
+    "MergeHub", "BroadcastHub", "PartitionHub", "ConsumerInfo",
+    "DevicePipeline", "Framing", "FramingException", "JsonFraming",
+    "RetryFlow",
     "StreamRefs", "SourceRef", "SinkRef",
     "Attributes", "Supervision",
     "RestartSource", "RestartFlow", "RestartSink", "RestartSettings",

@@ -159,6 +159,126 @@ class LengthFieldFraming(_LinearStage):
         return logic
 
 
+class JsonObjectFraming(_LinearStage):
+    """Bracket-counting JSON object scanner (reference: scaladsl/
+    JsonFraming.scala:17 objectScanner + impl/JsonObjectParser.scala):
+    emits one complete top-level `{...}` object per element from a chunked
+    byte stream, skipping whitespace, commas and the enclosing brackets of
+    an outer array, so both newline/comma-separated object streams and
+    `[{...},{...}]` documents frame identically. String literals (with
+    escapes) are opaque to the brace counter."""
+
+    _SKIP = frozenset(b" \t\r\n,[]")
+
+    def __init__(self, maximum_object_length: int = 1 << 20):
+        super().__init__("JsonObjectFraming")
+        self.max_len = maximum_object_length
+
+    def create_logic(self):  # noqa: C901
+        logic, in_, out = self._logic(), self.in_, self.out
+        stage = self
+        buf = bytearray()
+        pending: List[bytes] = []
+        # scan state survives chunk boundaries: pos = next unscanned byte,
+        # start = object start (-1 outside an object)
+        st = {"pos": 0, "start": -1, "depth": 0, "in_str": False,
+              "esc": False}
+
+        def scan() -> None:
+            while st["pos"] < len(buf):
+                b = buf[st["pos"]]
+                if st["depth"] == 0:
+                    if b == 0x7B:  # {
+                        st["start"] = st["pos"]
+                        st["depth"] = 1
+                    elif b not in stage._SKIP:
+                        raise FramingException(
+                            f"invalid JSON input: unexpected byte "
+                            f"0x{b:02x} outside an object")
+                elif st["esc"]:
+                    st["esc"] = False
+                elif st["in_str"]:
+                    if b == 0x5C:  # backslash
+                        st["esc"] = True
+                    elif b == 0x22:  # "
+                        st["in_str"] = False
+                elif b == 0x22:
+                    st["in_str"] = True
+                elif b == 0x7B:
+                    st["depth"] += 1
+                elif b == 0x7D:  # }
+                    st["depth"] -= 1
+                    if st["depth"] == 0:
+                        if st["pos"] - st["start"] + 1 > stage.max_len:
+                            raise FramingException(
+                                f"JSON object exceeds {stage.max_len} bytes")
+                        pending.append(bytes(buf[st["start"]:st["pos"] + 1]))
+                        del buf[:st["pos"] + 1]
+                        st["pos"] = -1
+                        st["start"] = -1
+                # in-progress length check: pos - start + 1 bytes consumed
+                # by the open object so far (same formula as at emit, so an
+                # exactly-max_len object passes and max_len+1 fails)
+                if st["depth"] > 0 and \
+                        st["pos"] - st["start"] + 1 > stage.max_len:
+                    raise FramingException(
+                        f"JSON object exceeds {stage.max_len} bytes")
+                st["pos"] += 1
+            # trim consumed bytes so memory stays bounded by max_len even
+            # when the input is mostly separators/whitespace (outside an
+            # object everything scanned is droppable; inside, everything
+            # before the object start is)
+            if st["start"] < 0:
+                del buf[:st["pos"]]
+                st["pos"] = 0
+            elif st["start"] > 0:
+                del buf[:st["start"]]
+                st["pos"] -= st["start"]
+                st["start"] = 0
+
+        def on_push():
+            buf.extend(logic.grab(in_))
+            try:
+                scan()
+            except FramingException as e:
+                logic.fail_stage(e)
+                return
+            if pending:
+                logic.push(out, pending.pop(0))
+            else:
+                logic.pull(in_)
+
+        def on_finish():
+            if st["depth"] > 0:
+                logic.fail_stage(FramingException(
+                    "stream finished with truncated JSON object"))
+                return
+            if pending:
+                logic.emit_multiple(out, list(pending))
+                pending.clear()
+            logic.complete_stage()
+
+        def on_pull():
+            if pending:
+                logic.push(out, pending.pop(0))
+            else:
+                logic.pull(in_)
+
+        logic.set_handler(in_, make_in_handler(on_push, on_finish))
+        logic.set_handler(out, make_out_handler(on_pull))
+        return logic
+
+
+class JsonFraming:
+    """Factory namespace (scaladsl/JsonFraming.scala)."""
+
+    @staticmethod
+    def object_scanner(maximum_object_length: int = 1 << 20):
+        from .dsl import Flow
+        return Flow().via_stage(lambda: JsonObjectFraming(
+            maximum_object_length))
+
+
 class Framing:
     """Factory namespace (scaladsl/Framing.scala)."""
 

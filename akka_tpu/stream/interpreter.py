@@ -21,11 +21,20 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ..actor.actor import Actor
 from .stage import GraphStageLogic, Inlet, Outlet
 
+# consecutive supervised on_pull failures on one connection before the
+# resume/restart directive is escalated to a stage failure. The bound is a
+# last-resort guard against a HOT livelock (a source whose on_pull throws
+# deterministically forever under a resuming decider); it is set far above
+# any plausible run of legitimately skipped bad records, and retries are
+# rescheduled through the host actor mailbox (not the in-loop queue) so
+# even a long run of failures stays fair to async events and cancellation
+MAX_PULL_RETRIES = 10_000
+
 
 class Connection:
     __slots__ = ("id", "out_logic", "outlet", "in_logic", "inlet", "state",
                  "element", "out_closed", "in_closed", "failure",
-                 "pending_complete", "pending_fail")
+                 "pending_complete", "pending_fail", "pull_retries")
 
     def __init__(self, cid: int, out_logic: GraphStageLogic, outlet: Outlet,
                  in_logic: GraphStageLogic, inlet: Inlet):
@@ -41,6 +50,7 @@ class Connection:
         self.failure: Optional[BaseException] = None
         self.pending_complete = False  # complete after in-flight push lands
         self.pending_fail: Optional[BaseException] = None
+        self.pull_retries = 0  # consecutive supervised on_pull failures
 
 
 @dataclass(frozen=True)
@@ -255,6 +265,7 @@ class GraphInterpreter:
                 if c.out_logic._drain_emit(c.outlet):
                     return
                 c.out_logic.out_handler(c.outlet).on_pull()
+                c.pull_retries = 0
             elif kind == "push":
                 if c.in_closed:
                     c.state = "idle"
@@ -331,8 +342,24 @@ class GraphInterpreter:
                 self.pull(failing, c.inlet)
             return True
         # pull: producing the element failed; leave the port pulled and
-        # retry (unfoldResource-with-resume semantics: read() is retried)
-        if c.state == "pulled" and not c.out_closed:
+        # retry (unfoldResource-with-resume semantics: read() is retried).
+        # Bounded + mailbox-rescheduled: a source whose on_pull throws
+        # deterministically forever under a resuming decider would
+        # otherwise spin the event loop hot (the reference cannot reach
+        # this state; it does not supervise source pulls, so any bound is
+        # stricter than parity requires)
+        c.pull_retries += 1
+        if c.pull_retries >= MAX_PULL_RETRIES:
+            return False
+
+        def requeue(_):
+            if c.state == "pulled" and not c.out_closed:
+                self.queue.append(("pull", c))
+        if self._self_ref is not None:
+            # hosted: bounce through the mailbox so async events, timers
+            # and cancellations interleave with the retry storm
+            self.enqueue_async(failing, requeue, None)
+        elif c.state == "pulled" and not c.out_closed:
             self.queue.append(("pull", c))
         return True
 

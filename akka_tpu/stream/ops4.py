@@ -1068,6 +1068,13 @@ class ActorRefBackpressureSource(_SourceStage):
                     if state["completing"] and not held:
                         self.complete(stage.out)
 
+            def post_stop(self):
+                # the forwarder outlives no materialization (WatchStage
+                # stops its helper the same way); without this every run
+                # leaked one live actor
+                if state["ref"] is not None:
+                    self.materializer.system.stop(state["ref"])
+
         logic = _L(self._shape)
         fut: Future = Future()
         mat_holder["ref"] = fut

@@ -131,14 +131,17 @@ def test_device_block_ring_public_api():
         block.tell((0, [1.0]))  # one token to every actor (bulk staged)
         h = get_handle(system)
         h.step(10)
-        received = block.read_state("received")
-        # every executed step delivers exactly one token per actor (the
-        # auto-pump may have stepped between the tell and the explicit run,
-        # so key off the authoritative device step counter)
+        # every executed step delivers exactly one token per actor; the
+        # auto-pump may step at ANY point between these reads, so snapshot
+        # the authoritative device step counter FIRST and lower-bound the
+        # delivered total (reading received first raced a pump slipping in
+        # between the two reads — observed once in a full-suite run)
         import jax
-        steps = int(jax.device_get(h.runtime.step_count))
-        assert steps >= 10
-        assert received.sum() == 256 * steps
+        steps_before = int(jax.device_get(h.runtime.step_count))
+        received = block.read_state("received")
+        assert steps_before >= 10
+        assert received.sum() >= 256 * steps_before
+        assert received.sum() % 256 == 0
         # single-row ref derived from the block works
         r0 = block[0]
         assert isinstance(r0, DeviceActorRef)

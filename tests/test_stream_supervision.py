@@ -130,6 +130,26 @@ def test_source_side_resume_retries_production(system):
     assert out == [0, 1, 2, 3, 4, 5]
 
 
+def test_source_side_resume_survives_long_failure_runs(system):
+    """200 CONSECUTIVE pull failures with an advancing cursor must all be
+    skipped (resume semantics) — the livelock guard's escalation bound only
+    exists for deterministic forever-throwers (code-review r5 finding)."""
+    state = {"cursor": 0}
+
+    def fn(_):
+        state["cursor"] += 1
+        c = state["cursor"]
+        if c <= 200:
+            raise RuntimeError(f"bad record {c}")
+        return (None, c) if c <= 203 else None
+
+    out = run_seq(
+        Source.unfold(None, fn).with_attributes(
+            Attributes.supervision_strategy(Supervision.resuming_decider)),
+        system)
+    assert out == [201, 202, 203]
+
+
 def test_named_and_name_attribute(system):
     src = Source.from_iterable([1]).named("my-source")
     assert run_seq(src, system) == [1]
