@@ -120,8 +120,17 @@ class PhiAccrualFailureDetector(FailureDetector):
         mean = self._history.mean + self.acceptable_heartbeat_pause
         std = max(self._history.std_deviation, self.min_std_deviation)
         y = (elapsed - mean) / std
-        # logistic approximation of the normal CDF (reference :230-238)
-        e = math.exp(-y * (1.5976 + 0.070566 * y * y))
+        # logistic approximation of the normal CDF (reference :230-238).
+        # The reference computes this in IEEE doubles, where a hugely
+        # NEGATIVE y (a fresh heartbeat against a wide acceptable-pause
+        # window, e.g. load-dilated test configs) overflows e to +inf and
+        # phi comes out 0; python's math.exp RAISES instead, which used to
+        # crash the cluster daemon's reap tick on every loaded run — clamp
+        # explicitly (exp(709) is the float64 edge)
+        exp_arg = -y * (1.5976 + 0.070566 * y * y)
+        if exp_arg > 709.0:
+            return 0.0  # arrival later is virtually certain: phi ~ 0
+        e = math.exp(exp_arg)
         if elapsed > mean:
             return -math.log10(e / (1.0 + e)) if e != 0 else 35.0
         return -math.log10(1.0 - 1.0 / (1.0 + e))

@@ -15,6 +15,30 @@ from akka_tpu.remote.transport import InProcTransport
 from akka_tpu.testkit import await_condition
 
 
+# -- phi-accrual failure detector (reference: AccrualFailureDetectorSpec) ----
+
+def test_phi_never_overflows_with_wide_pause_window():
+    """Regression (r5, the full-suite SBR flake root cause): with a wide
+    acceptable-heartbeat-pause (load-dilated configs) and a fresh
+    heartbeat, the logistic-CDF exponent exceeds float64's exp range; the
+    reference's JVM doubles overflow to +inf (phi 0) but python's math.exp
+    RAISED, crashing every reap tick so unreachability was never recorded."""
+    from akka_tpu.remote.failure_detector import PhiAccrualFailureDetector
+    for pause in (3.0, 6.6, 10.0, 60.0):
+        t = [0.0]
+        fd = PhiAccrualFailureDetector(
+            acceptable_heartbeat_pause=pause, min_std_deviation=0.1,
+            clock=lambda: t[0])
+        for _ in range(5):
+            fd.heartbeat()
+            t[0] += 0.1
+        assert fd.phi(t[0]) <= 0.1          # fresh: must not raise
+        assert fd.is_available_at(t[0])
+        # silence must still be detected: phi crosses any threshold
+        assert fd.phi(t[0] + pause + 30.0) > 16.0
+        assert not fd.is_available_at(t[0] + pause + 30.0)
+
+
 # -- vector clock (reference: VectorClockSpec) --------------------------------
 
 def test_vector_clock_ordering():
