@@ -23,6 +23,8 @@ from .persistence import (JOURNAL_FILE, JOURNAL_INMEM,  # noqa: F401
                           Persistence, RecoveryPermitter, SNAPSHOT_INMEM,
                           SNAPSHOT_LOCAL)
 from .eventsourced import PersistentActor  # noqa: F401
+from .adapter import (EventAdapter, EventAdapters, EventSeq,  # noqa: F401
+                      IdentityEventAdapter, SnapshotAdapter)
 from .at_least_once import (AtLeastOnceDelivery,  # noqa: F401
                             AtLeastOnceDeliverySnapshot,
                             MaxUnconfirmedMessagesExceededException,
@@ -48,6 +50,8 @@ __all__ = [
     "Persistence", "RecoveryPermitter",
     "JOURNAL_INMEM", "JOURNAL_FILE", "SNAPSHOT_INMEM", "SNAPSHOT_LOCAL",
     "PersistentActor",
+    "EventAdapter", "EventAdapters", "EventSeq", "IdentityEventAdapter",
+    "SnapshotAdapter",
     "AtLeastOnceDelivery", "AtLeastOnceDeliverySnapshot",
     "UnconfirmedDelivery", "UnconfirmedWarning",
     "MaxUnconfirmedMessagesExceededException",

@@ -20,6 +20,8 @@ import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..actor.actor import Actor
+from .adapter import EventAdapters
+from .adapter import _IDENTITY as _IDENTITY_ADAPTER
 from .messages import (AtomicWrite, DeleteMessagesFailure,
                        DeleteMessagesSuccess, DeleteMessagesTo,
                        PersistentRepr, RecoverySuccess, ReplayedMessage,
@@ -381,11 +383,48 @@ class JournalActor(Actor):
     """Async actor protocol over a sync plugin (reference:
     AsyncWriteJournal.scala receiveWriteMessages / ReplayMessages handling).
     Runs on its own dispatcher in the reference; here the actor's mailbox
-    already serializes plugin access per journal."""
+    already serializes plugin access per journal.
 
-    def __init__(self, plugin: JournalPlugin):
+    `adapters` (EventAdapters) is the per-journal domain<->journal-model
+    seam (reference: WriteJournalBase.preparePersistentBatch applying
+    toJournal on the write side, AsyncWriteJournal.adaptFromJournal fanning
+    each stored record out to 0..N ReplayedMessages on the read side)."""
+
+    def __init__(self, plugin: JournalPlugin, adapters=None):
         super().__init__()
         self.plugin = plugin
+        self.adapters = adapters if adapters is not None else EventAdapters()
+
+    def _adapt_to_journal(self, repr_: PersistentRepr) -> PersistentRepr:
+        """Apply the write-side adapter to the DOMAIN payload; a typed
+        tagger's Tagged wrapper is transparent (adapt inside, keep tags) —
+        and an adapter may itself RETURN Tagged to attach tags."""
+        payload, tags = repr_.payload, None
+        if isinstance(payload, Tagged):
+            payload, tags = payload.payload, payload.tags
+        adapter = self.adapters.get(type(payload))
+        if adapter is _IDENTITY_ADAPTER and tags is None:
+            return repr_
+        adapted = adapter.to_journal(payload)
+        manifest = adapter.manifest(payload) or repr_.manifest
+        if tags is not None:
+            # tagger tags and adapter-attached tags UNION (dropping either
+            # silently breaks events_by_tag for that source)
+            if isinstance(adapted, Tagged):
+                adapted = Tagged(adapted.payload, adapted.tags | tags)
+            else:
+                adapted = Tagged(adapted, tags)
+        out = repr_.with_payload(adapted)
+        return PersistentRepr(out.payload, out.sequence_nr,
+                              out.persistence_id, manifest, out.writer_uuid,
+                              out.deleted, out.timestamp)
+
+    def _adapt_from_journal(self, repr_: PersistentRepr) -> List[PersistentRepr]:
+        """Read-side: one stored record -> 0..N domain events, all sharing
+        the stored sequence_nr (reference: adaptFromJournal)."""
+        adapter = self.adapters.get(type(repr_.payload))
+        seq = adapter.from_journal(repr_.payload, repr_.manifest)
+        return [repr_.with_payload(ev) for ev in seq.events]
 
     def receive(self, message: Any) -> Any:
         if isinstance(message, WriteMessages):
@@ -414,7 +453,9 @@ class JournalActor(Actor):
             if failure is not None:
                 break
             try:
-                rejection = self.plugin.write_atomic(aw)
+                to_store = aw if self.adapters.is_empty else AtomicWrite(
+                    tuple(self._adapt_to_journal(r) for r in aw.payload))
+                rejection = self.plugin.write_atomic(to_store)
                 results.append((aw, rejection))
                 if rejection is None:
                     n_written += 1
@@ -440,11 +481,17 @@ class JournalActor(Actor):
 
     def _replay(self, msg: ReplayMessages) -> None:
         actor = msg.persistent_actor
+
+        def emit(r: PersistentRepr) -> None:
+            if self.adapters.is_empty:
+                actor.tell(ReplayedMessage(r), self.self_ref)
+                return
+            for adapted in self._adapt_from_journal(r):
+                actor.tell(ReplayedMessage(adapted), self.self_ref)
         try:
             self.plugin.replay(
                 msg.persistence_id, msg.from_sequence_nr, msg.to_sequence_nr,
-                msg.max,
-                lambda r: actor.tell(ReplayedMessage(r), self.self_ref))
+                msg.max, emit)
             highest = self.plugin.highest_sequence_nr(
                 msg.persistence_id, msg.from_sequence_nr)
             actor.tell(RecoverySuccess(highest), self.self_ref)

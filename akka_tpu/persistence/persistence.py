@@ -135,6 +135,7 @@ class Persistence:
             "max-concurrent-recoveries", 35)
         self._journals: Dict[str, ActorRef] = {}
         self._journal_plugins: Dict[str, JournalPlugin] = {}
+        self._event_adapters: Dict[str, Any] = {}  # plugin-id -> EventAdapters
         self._snapshots: Dict[str, ActorRef] = {}
         self._snapshot_plugins: Dict[str, SnapshotPlugin] = {}
         self._counter = 0
@@ -178,6 +179,19 @@ class Persistence:
             return LocalSnapshotStore(d)
         raise ValueError(f"unknown snapshot plugin id {plugin_id!r}")
 
+    def register_event_adapters(self, plugin_id: str, adapters) -> None:
+        """Bind an EventAdapters registry to a journal plugin id BEFORE its
+        first use (reference: the per-journal event-adapters config block,
+        EventAdapters.scala:25). Late registration raises — adapters must
+        see every write."""
+        pid = plugin_id or self.default_journal_id
+        with self._instance_lock:
+            if pid in self._journals:
+                raise RuntimeError(
+                    f"journal '{pid}' already started; register event "
+                    f"adapters before the first persistence use")
+            self._event_adapters[pid] = adapters
+
     def journal_for(self, plugin_id: str = "") -> ActorRef:
         pid = plugin_id or self.default_journal_id
         with self._instance_lock:
@@ -187,7 +201,8 @@ class Persistence:
                 self._journal_plugins[pid] = plugin
                 name = f"journal-{len(self._journals)}"
                 ref = self._journals[pid] = self.system.system_actor_of(
-                    Props.create(JournalActor, plugin), name)
+                    Props.create(JournalActor, plugin,
+                                 self._event_adapters.get(pid)), name)
             return ref
 
     def journal_plugin_for(self, plugin_id: str = "") -> JournalPlugin:

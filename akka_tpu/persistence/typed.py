@@ -139,7 +139,8 @@ class EventSourcedBehavior(ExtensibleBehavior):
                  tagger: Optional[Callable[[Any], frozenset]] = None,
                  on_signal: Optional[Callable[[Any, Signal], None]] = None,
                  recovery_completed: Optional[Callable[[Any, Any], None]] = None,
-                 journal_plugin_id: str = "", snapshot_plugin_id: str = ""):
+                 journal_plugin_id: str = "", snapshot_plugin_id: str = "",
+                 snapshot_adapter=None):
         self.persistence_id = persistence_id
         self.empty_state = empty_state
         self.command_handler = command_handler
@@ -151,6 +152,9 @@ class EventSourcedBehavior(ExtensibleBehavior):
         self.recovery_completed = recovery_completed
         self.journal_plugin_id = journal_plugin_id
         self.snapshot_plugin_id = snapshot_plugin_id
+        # state <-> stored-snapshot mapping incl. old-snapshot upcasts
+        # (reference: typed/SnapshotAdapter.scala:14, wired per behavior)
+        self.snapshot_adapter = snapshot_adapter
         # per-spawned-actor runtime, keyed by the actor's ref (the same
         # EventSourcedBehavior object may be spawned more than once)
         self._runtimes: dict = {}
@@ -234,7 +238,9 @@ class _ESRuntime:
     def _replaying_snapshot(self, ctx, msg) -> Behavior:
         if isinstance(msg, LoadSnapshotResult):
             if msg.snapshot is not None:
-                self.state = msg.snapshot.snapshot
+                stored = msg.snapshot.snapshot
+                self.state = stored if self.b.snapshot_adapter is None \
+                    else self.b.snapshot_adapter.from_journal(stored)
                 self.seq_nr = msg.snapshot.metadata.sequence_nr
             self.phase = "replaying-events"
             self.journal.tell(
@@ -380,7 +386,9 @@ class _ESRuntime:
         if not should:
             return
         md = SnapshotMetadata(self.b.persistence_id.id, seq_nr, time.time())
-        self.snapshot_store.tell(SaveSnapshot(md, self.state), ctx.self)
+        stored = self.state if self.b.snapshot_adapter is None \
+            else self.b.snapshot_adapter.to_journal(self.state)
+        self.snapshot_store.tell(SaveSnapshot(md, stored), ctx.self)
         if ret.snapshot_every > 0:
             keep_from = seq_nr - ret.snapshot_every * ret.keep_n_snapshots
             if keep_from > 0:

@@ -20,6 +20,8 @@ from .region import (ClusterShardingSettings, InProcRememberEntitiesStore,
 from .sharding import ClusterSharding
 from .typed import (ClusterShardingTyped, Entity, EntityContext, EntityRef,
                     EntityTypeKey)
+from .daemon_process import (ShardedDaemonProcess,
+                             ShardedDaemonProcessSettings)
 
 __all__ = [
     "ShardingEnvelope", "StartEntity", "StartEntityAck", "Passivate",
@@ -32,4 +34,5 @@ __all__ = [
     "ClusterShardingStats", "ShardState",
     "ClusterShardingTyped", "Entity", "EntityContext", "EntityRef",
     "EntityTypeKey",
+    "ShardedDaemonProcess", "ShardedDaemonProcessSettings",
 ]

