@@ -48,7 +48,8 @@ class ShardedBatchedSystem:
                  remote_capacity_per_pair: Optional[int] = None,
                  payload_dtype=jnp.float32, axis_name: str = "shards",
                  mailbox_slots: int = 0, reroute_strays: bool = False,
-                 spill_capacity: Optional[int] = None):
+                 spill_capacity: Optional[int] = None,
+                 delivery: str = "auto"):
         self.mesh = mesh if mesh is not None else make_mesh(n_devices, axis_name)
         self.axis = axis_name
         self.n_shards = self.mesh.shape[axis_name]
@@ -137,6 +138,7 @@ class ShardedBatchedSystem:
                               payload_dtype=payload_dtype,
                               slots=self.mailbox_slots,
                               n_global=self.capacity,
+                              delivery=delivery,
                               spill_cap=self.spill_cap)
         self._step_fn = None  # built lazily: tables may be set post-init
 

@@ -47,11 +47,11 @@ def fan_in_collector(state, inbox, ctx):
 
 
 def build_ring(n: int = 1 << 20, sharded: bool = False, n_devices=None,
-               static: bool = True):
+               static: bool = True, delivery: str = "auto"):
     if sharded:
         sys = ShardedBatchedSystem(capacity=n, behaviors=[ring_behavior],
                                    n_devices=n_devices, payload_width=PAYLOAD_W,
-                                   host_inbox_per_shard=8)
+                                   host_inbox_per_shard=8, delivery=delivery)
     else:
         topo = None
         if static:

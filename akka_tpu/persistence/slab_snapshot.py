@@ -28,15 +28,35 @@ import numpy as np
 
 
 _SLAB_KEYS = ("behavior_id", "alive", "step_count", "inbox_dst",
-              "inbox_payload", "inbox_valid")
+              "inbox_type", "inbox_payload", "inbox_valid")
 
 
 def slab_pytree(system) -> Dict[str, Any]:
-    """Extract the full device state of a BatchedSystem as a pytree."""
-    tree: Dict[str, Any] = {"state": dict(system.state)}
+    """Extract the full device state of a BatchedSystem (or
+    ShardedBatchedSystem) as a pytree of HOST copies. Copies are mandatory:
+    the step functions donate their input buffers, so a snapshot of live
+    device arrays would be deleted by the very next `run()`."""
+    tree: Dict[str, Any] = {
+        "state": {k: np.asarray(jax.device_get(v))
+                  for k, v in system.state.items()}}
     for k in _SLAB_KEYS:
-        tree[k] = getattr(system, k)
+        v = getattr(system, k, None)
+        if v is not None:
+            tree[k] = np.asarray(jax.device_get(v))
     return tree
+
+
+def _put_like(system, arr, current) -> Any:
+    """Re-place a restored array with the sharding its predecessor had
+    (a sharded system's slabs must go back onto the mesh, not onto the
+    default device). Sharding metadata survives donation, so `current`
+    may be a deleted array and still answer .sharding."""
+    a = jnp.asarray(arr)
+    try:
+        sharding = current.sharding
+    except Exception:  # noqa: BLE001 — plain single-device system
+        return a
+    return jax.device_put(a, sharding)
 
 
 def restore_slab_pytree(system, tree: Dict[str, Any]) -> None:
@@ -48,15 +68,19 @@ def restore_slab_pytree(system, tree: Dict[str, Any]) -> None:
             raise ValueError(
                 f"slab shape mismatch for state[{col!r}]: "
                 f"{tuple(arr.shape)} vs {tuple(cur.shape)}")
-        system.state[col] = jnp.asarray(arr)
+        system.state[col] = _put_like(system, arr, cur)
     for k in _SLAB_KEYS:
-        cur = getattr(system, k)
+        if k not in tree:
+            continue  # older snapshot without this column
+        cur = getattr(system, k, None)
         arr = tree[k]
+        if cur is None:
+            continue
         if hasattr(cur, "shape") and tuple(cur.shape) != tuple(
                 np.asarray(arr).shape):
             raise ValueError(f"slab shape mismatch for {k}: "
                              f"{np.asarray(arr).shape} vs {tuple(cur.shape)}")
-        setattr(system, k, jnp.asarray(arr))
+        setattr(system, k, _put_like(system, arr, cur))
 
 
 def _try_orbax():

@@ -108,10 +108,15 @@ class DeviceShardRegion:
         spare = spec.spare_blocks if spec.spare_blocks is not None \
             else n_devices
         # pad spares so every device hosts the same number of blocks
-        # (the mesh shards the row space evenly)
+        # (the mesh shards the row space evenly). The ask promise rows
+        # (bridge reply-row protocol) are carved out of one spare/padding
+        # block so capacity does not grow for regions that never ask; only
+        # a region with NO free block at all pays for an extra stripe
         total_blocks = spec.n_shards + spare
         if total_blocks % n_devices:
             total_blocks += n_devices - total_blocks % n_devices
+        if total_blocks == spec.n_shards:  # zero spares and no padding
+            total_blocks += n_devices
         self.n_devices = n_devices
         self.blocks_per_device = total_blocks // n_devices
         self.total_blocks = total_blocks
@@ -120,7 +125,8 @@ class DeviceShardRegion:
 
         self.system = ShardedBatchedSystem(
             capacity=capacity,
-            behaviors=[spec.behavior, *spec.extra_behaviors],
+            behaviors=[spec.behavior, *spec.extra_behaviors,
+                       self._promise_behavior(spec)],
             mesh=mesh, n_devices=n_devices,
             payload_width=spec.payload_width, out_degree=spec.out_degree,
             host_inbox_per_shard=spec.host_inbox_per_shard,
@@ -135,9 +141,15 @@ class DeviceShardRegion:
             (order // n_devices)
         self._shard_block = stripe.astype(np.int32)
         used = set(int(b) for b in self._shard_block)
-        self._free_blocks: List[int] = sorted(
-            set(range(total_blocks)) - used)
+        free = sorted(set(range(total_blocks)) - used)
+        # the last free block becomes the promise block (never a shard
+        # home, never a rebalance target); its rows resolve asks
+        self._promise_block = free.pop()
+        self._free_blocks: List[int] = free
+        self._promise_free: List[int] = list(range(self.eps))
+        self._promise_spawned = False
         self._lock = threading.Lock()
+        self._ask_lock = threading.Lock()  # asks serialize (stepping API)
 
         # entity registry: per-shard entity_id -> index (remember-entities)
         self._entities: List[Dict[str, int]] = [dict()
@@ -145,6 +157,109 @@ class DeviceShardRegion:
         self._spawned = np.zeros((spec.n_shards,), np.int32)
 
         self._sync_tables()
+
+    # ----------------------------------------------------------------- ask
+    @staticmethod
+    def _promise_behavior(spec: DeviceEntity) -> BatchedBehavior:
+        """Promise rows (batched/bridge.py protocol on the mesh): a reply
+        emitted by a remote-shard entity crosses the all_to_all exchange
+        into this row; the host polls the replied latch."""
+        from ..batched import Emit, behavior
+        P, k = spec.payload_width, spec.out_degree
+
+        if spec.mailbox_slots > 0:
+            @behavior("__shard_promise",
+                      {"__promise_reply": ((P,), jnp.float32),
+                       "__promise_replied": ((), jnp.bool_)}, inbox="slots")
+            def promise(state, mailbox, ctx):
+                inbox = mailbox.reduce()
+                got = inbox.count > 0
+                return ({"__promise_reply": jnp.where(
+                             got, inbox.sum, state["__promise_reply"]),
+                         "__promise_replied":
+                             state["__promise_replied"] | got},
+                        Emit.none(k, P))
+        else:
+            @behavior("__shard_promise",
+                      {"__promise_reply": ((P,), jnp.float32),
+                       "__promise_replied": ((), jnp.bool_)})
+            def promise(state, inbox, ctx):
+                got = inbox.count > 0
+                return ({"__promise_reply": jnp.where(
+                             got, inbox.sum, state["__promise_reply"]),
+                         "__promise_replied":
+                             state["__promise_replied"] | got},
+                        Emit.none(k, P))
+        return promise
+
+    def _ensure_promise_rows(self) -> None:
+        with self._lock:
+            if self._promise_spawned:
+                return
+            self._promise_spawned = True
+        sys = self.system
+        base = self._promise_block * self.eps
+        rows = jnp.arange(base, base + self.eps, dtype=jnp.int32)
+        bid = len(sys.behaviors) - 1  # promise behavior registered last
+        sys.behavior_id = sys.behavior_id.at[rows].set(bid)
+        sys.alive = sys.alive.at[rows].set(True)
+
+    def ask(self, shard: int, index: int, message, steps: int = 2,
+            max_extra_steps: int = 8):
+        """Request/response to entity (shard, index) across the mesh: the
+        reply-to promise row rides the payload's LAST column (the batched
+        bridge's ask convention — the entity behavior answers with
+        `Emit.single(reply_dst(payload), ...)`); returns the reply payload.
+
+        Runs `steps` steps (request out + reply back), then single steps up
+        to `max_extra_steps` more before declaring the ask unanswered.
+        Asks SERIALIZE (this is a stepping API driving the shared runtime);
+        a timed-out ask's slot is retired, not reused — a late reply
+        landing in a recycled row would otherwise answer the wrong ask."""
+        from ..batched.bridge import max_exact_row_id
+        with self._ask_lock:
+            self._ensure_promise_rows()
+            sys = self.system
+            with self._lock:
+                if not self._promise_free:
+                    raise RuntimeError("promise rows exhausted")
+                slot = self._promise_free.pop()
+            prow = self._promise_block * self.eps + slot
+            if prow > max_exact_row_id(sys.payload_dtype):
+                with self._lock:
+                    self._promise_free.append(slot)
+                raise ValueError(
+                    f"promise row {prow} not exactly representable in "
+                    f"{jnp.dtype(sys.payload_dtype).name} payloads")
+            sys.state["__promise_replied"] = \
+                sys.state["__promise_replied"].at[prow].set(False)
+            payload = np.zeros((sys.payload_width,), np.float32)
+            body = np.atleast_1d(np.asarray(message, np.float32)).reshape(-1)
+            payload[:min(len(body), sys.payload_width - 1)] = \
+                body[:sys.payload_width - 1]
+            payload[-1] = float(prow)
+            sys.tell(self.row_of(shard, index), payload)
+
+            def replied() -> bool:
+                return bool(sys.read_state(
+                    "__promise_replied",
+                    np.asarray([prow], np.int32))[0])
+
+            budgets = [steps] + [1] * max_extra_steps
+            for n_steps in budgets:
+                sys.run(n_steps)
+                sys.block_until_ready()
+                if replied():
+                    with self._lock:
+                        self._promise_free.append(slot)
+                    return np.asarray(sys.read_state(
+                        "__promise_reply",
+                        np.asarray([prow], np.int32))[0])
+            # timed out: RETIRE the slot (late replies must land in a row
+            # no future ask will read — the bridge's promise-zombie rule)
+            raise TimeoutError(
+                f"ask to shard {shard} index {index} unanswered after "
+                f"{steps + max_extra_steps} steps")
 
     # ------------------------------------------------------------ addressing
     def shard_of(self, entity_id: str) -> int:
@@ -208,14 +323,23 @@ class DeviceShardRegion:
         from jax.sharding import NamedSharding, PartitionSpec as P
         sys = self.system
         alive = np.zeros((sys.capacity,), bool)
+        behavior_id = np.zeros((sys.capacity,), np.int32)
         for s in range(self.spec.n_shards):
             base = int(self._shard_block[s]) * self.eps
             alive[base:base + self.eps] = True
             self._spawned[s] = self.eps
+        # the wholesale replace must preserve promise rows a prior ask()
+        # spawned (asks after allocate_all spawn lazily as usual; rows
+        # never asked stay dead so the user-visible alive mask is exact)
+        with self._lock:
+            if self._promise_spawned:
+                pbase = self._promise_block * self.eps
+                alive[pbase:pbase + self.eps] = True
+                behavior_id[pbase:pbase + self.eps] = len(sys.behaviors) - 1
         shard = NamedSharding(sys.mesh, P(sys.axis))
         sys.alive = jax.device_put(jnp.asarray(alive), shard)
         sys.behavior_id = jax.device_put(
-            jnp.zeros((sys.capacity,), jnp.int32), shard)
+            jnp.asarray(behavior_id), shard)
 
     # ------------------------------------------------------------- rebalance
     def rebalance(self, shard: int, to_device: Optional[int] = None) -> int:
