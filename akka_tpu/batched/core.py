@@ -40,6 +40,23 @@ from .behavior import BatchedBehavior
 from .step import StepCore
 
 
+def drive_pipelined(step_once: Callable[[], None],
+                    latest_handle: Callable[[], Any],
+                    n_steps: int, depth: int) -> None:
+    """Shared enqueue-ahead driver (BatchedSystem and ShardedBatchedSystem
+    run_pipelined): dispatch up to `depth` single-step programs before
+    blocking on the oldest, keyed off each dispatch's step-count handle."""
+    if depth < 1:
+        raise ValueError("depth must be >= 1")
+    from collections import deque
+    inflight: deque = deque()  # step_count handles, oldest first
+    for _ in range(n_steps):
+        step_once()
+        inflight.append(latest_handle())
+        while len(inflight) >= depth:
+            jax.block_until_ready(inflight.popleft())
+
+
 class BatchedSystem:
     """Single-device (or single-shard) batched actor space.
 
@@ -566,6 +583,22 @@ class BatchedSystem:
         fr = self.flight_recorder
         if fr is not None:
             fr.device_step("batched", n_steps, _time.perf_counter() - t0)
+
+    def run_pipelined(self, n_steps: int, depth: int = 2) -> None:
+        """n SEPARATE single-step dispatches with up to `depth` programs in
+        flight: step k+1 is enqueued before step k completes, hiding host
+        program-launch latency (on a tunneled backend: tunnel RTT) behind
+        device execution. Donation makes the hand-off free — each dispatch
+        consumes the previous dispatch's not-yet-materialized outputs, so
+        the host never syncs inside the window (Artery's enqueue/flush
+        decoupling, Association.scala:330-395, as a step driver).
+
+        Unlike run(), host tells staged BETWEEN dispatches ride in the
+        next step (run() fuses the whole window into one program that
+        flushes once) — this is the latency-oriented driver, run() the
+        throughput-oriented one."""
+        drive_pipelined(lambda: self.step(), lambda: self.step_count,
+                        n_steps, depth)
 
     def warmup(self) -> None:
         """Execute the step AND the flush once on throwaway zero-filled

@@ -75,18 +75,31 @@ class ShardedBatchedSystem:
         else:
             self.spill_cap = 0
         # forward inbox messages whose home shard moved (rebalance) one
-        # more hop instead of dropping them; costs a larger bucketing sort
+        # more hop instead of dropping them. The stray pass costs a 2x
+        # exchange sort + 2x delivery input, so it is a MODE, not an
+        # always-on tax (r4 weak #5: the always-on pass made the public
+        # sharding API 3-5x slower than the raw runtime in steady state):
+        # enter_stray_mode() at rebalance, exit_stray_mode() once drained.
+        # The reference shape is the same — ShardRegion buffers/forwards
+        # only DURING hand-off (ShardRegion.scala:968,1056), while
+        # deliverMessage stays a hash + table lookup (:1046).
         self.reroute_strays = bool(reroute_strays)
+        self.stray_mode = False
         # lossless default: every local emission could target a single
-        # shard; with stray rerouting, one rebalanced block's worth of
-        # forwarded in-flight messages can ride alongside a full emission
-        # batch, so the default doubles (overflow is still counted either
-        # way — `dropped` is the guard, this is the sizing heuristic)
+        # shard; in stray mode, one rebalanced block's worth of forwarded
+        # in-flight messages can ride alongside a full emission batch, so
+        # stray sizing doubles (overflow is still counted either way —
+        # `dropped` is the guard, this is the sizing heuristic)
         if remote_capacity_per_pair:
-            self.pair_cap = remote_capacity_per_pair
+            # an EXPLICIT cap is a memory bound the user provisioned for:
+            # honor it in both modes (overflow is counted in `dropped`,
+            # exactly as before the mode split)
+            self.pair_cap_base = remote_capacity_per_pair
+            self.pair_cap_stray = remote_capacity_per_pair
         else:
-            self.pair_cap = self.local_n * out_degree * \
-                (2 if reroute_strays else 1)
+            self.pair_cap_base = self.local_n * out_degree
+            self.pair_cap_stray = 2 * self.pair_cap_base
+        self.pair_cap = self.pair_cap_base
 
         self.state_spec: Dict[str, Tuple[Tuple[int, ...], Any]] = {}
         for b in self.behaviors:
@@ -141,9 +154,10 @@ class ShardedBatchedSystem:
                               delivery=delivery,
                               spill_cap=self.spill_cap)
         self._step_fn = None  # built lazily: tables may be set post-init
+        self._step_cache: Dict[bool, Any] = {}  # stray-mode -> compiled step
 
     # -------------------------------------------------------------- builders
-    def _build_step(self):
+    def _build_step(self, stray: bool = False):
         n_local, n_shards, k_out = self.local_n, self.n_shards, self.out_degree
         p_w, dtype = self.payload_width, self.payload_dtype
         pair_cap, m_local, axis = self.pair_cap, self.m_local, self.axis
@@ -172,7 +186,7 @@ class ShardedBatchedSystem:
             out_payload = emits.payload.reshape(-1, p_w)
             out_type = emits.type.reshape(-1)
             out_valid = emits.valid.reshape(-1) & (out_dst >= 0) & (out_dst < n_global)
-            if self.reroute_strays:
+            if stray:
                 # inbox rows addressed OUTSIDE this shard (a shard was
                 # rebalanced after the message was exchanged): forward them
                 # one more hop instead of dropping — ShardRegion buffering-
@@ -355,15 +369,97 @@ class ShardedBatchedSystem:
         """Install/replace the replicated lookup tables behaviors see via
         ctx.tables. Changing the KEY SET after the first run retraces the
         step program; changing only the values does not."""
-        rebuild = set(tables) != set(self.tables) and self._step_fn is not None
+        rebuild = set(tables) != set(self.tables) and \
+            (self._step_fn is not None or self._step_cache)
         self.tables = {k: jnp.asarray(v) for k, v in tables.items()}
         if rebuild:
-            self._step_fn = self._build_step()
+            self._step_cache.clear()
+            self._step_fn = None
+
+    # ------------------------------------------------------- stray handoff
+    def _relayout_inbox(self, new_pair_cap: int) -> None:
+        """Re-grid the inbox buffers for a different per-pair exchange
+        capacity. Layout per shard block: [spill | n_shards*pair_cap |
+        host]; within each pair chunk, received rows are rank-packed at
+        the chunk start, so growing pads each chunk's tail and shrinking
+        slices it (the caller has verified the tail is empty)."""
+        ns, sc, hi = self.n_shards, self.spill_cap, self.host_inbox
+        old_pc, old_ml = self.pair_cap, self.m_local
+        new_ml = sc + ns * new_pair_cap + hi
+        shard = NamedSharding(self.mesh, P(self.axis))
+
+        def regrid(arr, fill):
+            tail_shape = arr.shape[1:]
+            v = arr.reshape(ns, old_ml, *tail_shape)
+            spill = v[:, :sc]
+            pairs = v[:, sc:sc + ns * old_pc].reshape(
+                ns, ns, old_pc, *tail_shape)
+            host = v[:, sc + ns * old_pc:]
+            if new_pair_cap > old_pc:
+                pad = jnp.full((ns, ns, new_pair_cap - old_pc, *tail_shape),
+                               fill, arr.dtype)
+                pairs = jnp.concatenate([pairs, pad], axis=2)
+            else:
+                pairs = pairs[:, :, :new_pair_cap]
+            out = jnp.concatenate(
+                [spill, pairs.reshape(ns, ns * new_pair_cap, *tail_shape),
+                 host], axis=1)
+            return jax.device_put(out.reshape(ns * new_ml, *tail_shape),
+                                  shard)
+
+        self.inbox_dst = regrid(self.inbox_dst, -1)
+        self.inbox_type = regrid(self.inbox_type, 0)
+        self.inbox_payload = regrid(self.inbox_payload, 0)
+        self.inbox_valid = regrid(self.inbox_valid, False)
+        self.pair_cap = new_pair_cap
+        self.m_local = new_ml
+
+    def enter_stray_mode(self) -> None:
+        """Switch to the hand-off step variant: 2x per-pair exchange
+        capacity and the stray-forwarding pass (inbox rows addressed
+        outside their shard ride the next exchange). Call at rebalance;
+        exit once drained — the variant costs ~2x per step."""
+        if not self.reroute_strays:
+            raise RuntimeError(
+                "system built with reroute_strays=False has no stray step")
+        if self.stray_mode:
+            return
+        self._relayout_inbox(self.pair_cap_stray)
+        self.stray_mode = True
+
+    def exit_stray_mode(self) -> bool:
+        """Back to the steady-state step once it is SAFE: (a) no stray
+        rows remain anywhere in the inbox (a stray surviving into the
+        non-stray step would be silently erased by the next exchange), and
+        (b) no pair chunk holds rows past the base capacity (the shrink
+        slices chunk tails). Returns False — staying in stray mode — if
+        forwarded traffic is still in flight on either count."""
+        if not self.stray_mode:
+            return True
+        ns, sc, ml = self.n_shards, self.spill_cap, self.m_local
+        valid = np.asarray(jax.device_get(self.inbox_valid)).reshape(ns, ml)
+        dst = np.asarray(jax.device_get(self.inbox_dst)).reshape(ns, ml)
+        # (a) any valid row addressed outside its hosting shard's range?
+        bases = (np.arange(ns) * self.local_n)[:, None]
+        stray = valid & ((dst < bases) | (dst >= bases + self.local_n))
+        if stray.any():
+            return False
+        # (b) any legit row parked past the base capacity of its chunk?
+        pairs_valid = valid[:, sc:sc + ns * self.pair_cap].reshape(
+            ns, ns, self.pair_cap)
+        if self.pair_cap_base < self.pair_cap and \
+                pairs_valid[:, :, self.pair_cap_base:].any():
+            return False
+        self._relayout_inbox(self.pair_cap_base)
+        self.stray_mode = False
+        return True
 
     # ------------------------------------------------------------------ step
     def run(self, n_steps: int = 1) -> None:
+        self._step_fn = self._step_cache.get(self.stray_mode)
         if self._step_fn is None:
-            self._step_fn = self._build_step()
+            self._step_fn = self._step_cache[self.stray_mode] = \
+                self._build_step(self.stray_mode)
         self._flush_staged()
         (self.state, self.behavior_id, self.alive, self.inbox_dst,
          self.inbox_type, self.inbox_payload, self.inbox_valid, self.dropped,
@@ -374,6 +470,14 @@ class ShardedBatchedSystem:
                           self.step_count, self.tables, n_steps)
 
     step = run
+
+    def run_pipelined(self, n_steps: int, depth: int = 2) -> None:
+        """Single-step dispatches with up to `depth` in flight (see
+        BatchedSystem.run_pipelined): hides host/tunnel launch latency
+        behind the mesh step; donated carries make the overlap free."""
+        from .core import drive_pipelined
+        drive_pipelined(lambda: self.run(1), lambda: self.step_count,
+                        n_steps, depth)
 
     def read_state(self, col: str, ids: Optional[np.ndarray] = None) -> np.ndarray:
         arr = self.state[col]

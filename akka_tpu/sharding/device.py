@@ -150,6 +150,7 @@ class DeviceShardRegion:
         self._promise_spawned = False
         self._lock = threading.Lock()
         self._ask_lock = threading.Lock()  # asks serialize (stepping API)
+        self._stray_steps_left = 0         # hand-off drain window
 
         # entity registry: per-shard entity_id -> index (remember-entities)
         self._entities: List[Dict[str, int]] = [dict()
@@ -354,6 +355,11 @@ class DeviceShardRegion:
             raise RuntimeError(
                 f"rebalance of shard {shard} denied: coordination lease "
                 f"{lease.settings.lease_name!r} is held elsewhere")
+        # hand-off window: the stray-forwarding step variant runs until the
+        # in-flight messages bound for the old block have drained (the
+        # steady-state step skips the stray pass entirely — r4 weak #5)
+        self.system.enter_stray_mode()
+        self._stray_steps_left = max(self._stray_steps_left, 3)
         with self._lock:
             old_block = int(self._shard_block[shard])
             candidates = self._free_blocks
@@ -410,7 +416,21 @@ class DeviceShardRegion:
 
     # ------------------------------------------------------------------ run
     def run(self, n_steps: int = 1) -> None:
-        self.system.run(n_steps)
+        # confine the ~2x-cost stray program to the drain window: a big
+        # batched run() after a rebalance must not scan hundreds of steps
+        # through the hand-off variant (exactly the steady-state tax the
+        # mode split removed)
+        while n_steps > 0 and self._stray_steps_left > 0:
+            k = min(n_steps, self._stray_steps_left)
+            self.system.run(k)
+            n_steps -= k
+            self._stray_steps_left -= k
+            if self._stray_steps_left <= 0:
+                self.system.block_until_ready()
+                if not self.system.exit_stray_mode():
+                    self._stray_steps_left = 1  # still draining: retry
+        if n_steps > 0:
+            self.system.run(n_steps)
 
     def block_until_ready(self) -> None:
         self.system.block_until_ready()
