@@ -140,7 +140,7 @@ class EventSourcedBehavior(ExtensibleBehavior):
                  on_signal: Optional[Callable[[Any, Signal], None]] = None,
                  recovery_completed: Optional[Callable[[Any, Any], None]] = None,
                  journal_plugin_id: str = "", snapshot_plugin_id: str = "",
-                 snapshot_adapter=None):
+                 snapshot_adapter=None, event_adapter=None):
         self.persistence_id = persistence_id
         self.empty_state = empty_state
         self.command_handler = command_handler
@@ -155,6 +155,11 @@ class EventSourcedBehavior(ExtensibleBehavior):
         # state <-> stored-snapshot mapping incl. old-snapshot upcasts
         # (reference: typed/SnapshotAdapter.scala:14, wired per behavior)
         self.snapshot_adapter = snapshot_adapter
+        # per-behavior domain<->journal event mapping with 1->N read
+        # upcasting (reference: typed/EventAdapter.scala, applied before
+        # the journal — composes with the journal-level EventAdapters
+        # registry, which sees this adapter's OUTPUT)
+        self.event_adapter = event_adapter
         # per-spawned-actor runtime, keyed by the actor's ref (the same
         # EventSourcedBehavior object may be spawned more than once)
         self._runtimes: dict = {}
@@ -258,8 +263,13 @@ class _ESRuntime:
     def _replaying_events(self, ctx, msg) -> Behavior:
         if isinstance(msg, ReplayedMessage):
             self.seq_nr = msg.persistent.sequence_nr
-            self.state = self.b.event_handler(self.state,
-                                              msg.persistent.payload)
+            payload = msg.persistent.payload
+            if self.b.event_adapter is not None:
+                for domain in self.b.event_adapter.from_journal(
+                        payload, msg.persistent.manifest).events:
+                    self.state = self.b.event_handler(self.state, domain)
+            else:
+                self.state = self.b.event_handler(self.state, payload)
         elif isinstance(msg, RecoverySuccess):
             self.seq_nr = max(self.seq_nr, msg.highest_sequence_nr)
             self.phase = "running"
@@ -321,13 +331,20 @@ class _ESRuntime:
             reprs = []
             for ev in effect.events:
                 self.seq_nr += 1
-                payload = ev
+                payload, manifest = ev, ""
+                ea = self.b.event_adapter
+                if ea is not None:
+                    payload = ea.to_journal(ev)
+                    manifest = ea.manifest(ev)
                 if self.b.tagger is not None:
+                    # the tagger sees the DOMAIN event (it is part of the
+                    # behavior's vocabulary, not the journal model's)
                     tags = self.b.tagger(ev)
                     if tags:
-                        payload = Tagged(ev, frozenset(tags))
+                        payload = Tagged(payload, frozenset(tags))
                 reprs.append(PersistentRepr(payload, self.seq_nr,
                                             self.b.persistence_id.id,
+                                            manifest=manifest,
                                             writer_uuid=self.writer_uuid))
             self.pending_events = len(reprs)
             self.pending_effects.append(effect)
@@ -345,9 +362,16 @@ class _ESRuntime:
         ev = persistent.payload
         if isinstance(ev, Tagged):
             ev = ev.payload
-        self.state = self.b.event_handler(self.state, ev)
+        # the journal echoes the JOURNAL model; the event handler's (and
+        # snapshot_when's) vocabulary is the domain model — the adapter's
+        # read side is authoritative for the mapping (1->N folds in order)
+        events = [ev] if self.b.event_adapter is None else \
+            self.b.event_adapter.from_journal(ev, persistent.manifest).events
+        for domain in events:
+            self.state = self.b.event_handler(self.state, domain)
         self.pending_events -= 1
-        self._maybe_snapshot(ctx, ev, persistent.sequence_nr)
+        if events:
+            self._maybe_snapshot(ctx, events[-1], persistent.sequence_nr)
         if self.pending_events == 0:
             stop = self._finish_effect(ctx)
             if stop:

@@ -298,6 +298,56 @@ def test_snapshot_adapter_upcasts_old_snapshot(tmp_path):
         system2.await_termination(10.0)
 
 
+def test_typed_event_adapter_on_behavior(tmp_path):
+    """Per-behavior typed EventAdapter (reference: persistence-typed
+    EventAdapter.scala): write-side detachment + read-side restore applied
+    by the behavior itself, without any journal-level registry."""
+    d = str(tmp_path / "j")
+    pid = _plugin_id("typed-ea")
+    Persistence.register_journal_plugin(pid, lambda _s, _c: FileJournal(d))
+
+    def command_handler(state, cmd):
+        if isinstance(cmd, tuple) and cmd[0] == "add":
+            return Effect.persist(ItemAdded(cmd[1]))
+        return Effect.reply(cmd, tuple(state))
+
+    def event_handler(state, event):
+        assert isinstance(event, ItemAdded), event
+        return state + [event.item]
+
+    def spawn(system, name):
+        return system.actor_of(props_from_behavior(EventSourcedBehavior(
+            PersistenceId.of("Cart", "tea1"), [], command_handler,
+            event_handler, journal_plugin_id=pid,
+            event_adapter=WrappingAdapter())), name)
+
+    system = _system(pid)
+    try:
+        ref = spawn(system, "cart")
+        probe = TestProbe(system)
+        ref.tell(("add", "kiwi"))
+        ref.tell(probe.ref)
+        assert probe.receive_one(10.0) == ("kiwi",)
+    finally:
+        system.terminate()
+        system.await_termination(10.0)
+
+    stored = []
+    FileJournal(d).replay("Cart|tea1", 1, 2**63 - 1, 2**63 - 1, stored.append)
+    assert [type(r.payload) for r in stored] == [Wrapped]
+    assert stored[0].manifest == "wrapped-v1"
+
+    system2 = _system(pid)
+    try:
+        ref = spawn(system2, "cart")
+        probe = TestProbe(system2)
+        ref.tell(probe.ref)
+        assert probe.receive_one(10.0) == ("kiwi",)
+    finally:
+        system2.terminate()
+        system2.await_termination(10.0)
+
+
 def test_late_adapter_registration_rejected(tmp_path):
     pid = _plugin_id("late")
     Persistence.register_journal_plugin(

@@ -38,7 +38,19 @@ PUBLISHERS = {
         .map_concat(lambda g: g),
     "async_island": lambda n: Source.from_iterable(range(n)).async_()
         .map(lambda x: x),
+    # round-5 tail: JsonFraming as a publisher of framed objects
+    "json_framing": lambda n: _json_frames(n),
 }
+
+
+def _json_frames(n):
+    from akka_tpu.stream import JsonFraming
+    payload = b"".join(b'{"i":%d}' % i for i in range(n))
+    # frames arrive as bytes; map to ints so ordering rules can compare
+    return Source.from_iterable([payload[i:i + 7] for i in
+                                 range(0, len(payload), 7)]) \
+        .via(JsonFraming.object_scanner()) \
+        .map(lambda b: int(b[5:-1]))
 
 
 @pytest.mark.parametrize("name", sorted(PUBLISHERS))
@@ -62,7 +74,17 @@ PROCESSORS = {
     "wire_tap": lambda: Flow().wire_tap(lambda x: None),
     "scan_async_passthrough": lambda: Flow().map(lambda x: x)
         .stateful_map_concat(lambda: lambda x: [x]),
+    # round-5 tail: RetryFlow wrapping an identity inner flow with a
+    # never-retry decider is itself an identity processor
+    "retry_flow_identity": lambda: _retry_identity(),
 }
+
+
+def _retry_identity():
+    from akka_tpu.stream import RetryFlow
+    return RetryFlow.with_backoff(0.001, 0.01, 0.0, 2,
+                                  Flow().map(lambda x: x),
+                                  lambda i, o: None)
 
 
 @pytest.mark.parametrize("name", sorted(PROCESSORS))
