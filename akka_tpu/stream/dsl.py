@@ -1430,6 +1430,34 @@ class Sink:
             ref, on_complete_message, on_failure_message))
 
     @staticmethod
+    def actor_ref_with_backpressure(ref, on_init_message: Any,
+                                    ack_message: Any,
+                                    on_complete_message: Any,
+                                    on_failure_message: Callable[
+                                        [BaseException], Any] = None
+                                    ) -> "Sink":
+        """Each element waits for the target actor's `ack_message` before
+        the next is pulled (scaladsl Sink.actorRefWithBackpressure)."""
+        from . import ops4 as _ops4
+        return Sink.from_graph(lambda: _ops4.ActorRefBackpressureSink(
+            ref, on_init_message, ack_message, on_complete_message,
+            on_failure_message))
+
+    @staticmethod
+    def combine(first: "Sink", second: "Sink", *rest: "Sink") -> "Sink":
+        """Broadcast every element to all given sinks; mat value is the
+        tuple of their mat values (scaladsl Sink.combine with a
+        Broadcast strategy)."""
+        sinks = [first, second, *rest]
+
+        def build(b: _Builder, upstream: Outlet):
+            bc, _ = b.add(_ops.BroadcastStage(len(sinks)))
+            b.connect(upstream, bc.shape.inlets[0])
+            return tuple(s._build(b, out)
+                         for s, out in zip(sinks, bc.shape.outlets))
+        return Sink(build)
+
+    @staticmethod
     def count() -> "Sink":
         return Sink.fold(0, lambda acc, _elem: acc + 1)
 

@@ -1084,6 +1084,88 @@ class ActorRefBackpressureSource(_SourceStage):
 
 # ================================= sinks ====================================
 
+class ActorRefBackpressureSink(_SinkStage):
+    """scaladsl Sink.actorRefWithBackpressure: `on_init` then each element
+    goes to `ref` with an ack-forwarder as sender; the next element is
+    pulled only after `ack_message` comes back, so the target actor paces
+    the stream. `on_complete`/`on_failure(ex)` close the conversation."""
+
+    def __init__(self, ref: Any, on_init: Any, ack_message: Any,
+                 on_complete: Any, on_failure=None):
+        super().__init__("ActorRefBackpressureSink")
+        self.ref = ref
+        self.on_init = on_init
+        self.ack_message = ack_message
+        self.on_complete = on_complete
+        self.on_failure = on_failure
+
+    def create_logic(self):
+        from ..actor.actor import Actor
+        from ..actor.props import Props
+        stage = self
+        in_ = self.in_
+        st = {"fwd": None, "awaiting": 0, "finishing": False}
+
+        class _Fwd(Actor):
+            def __init__(self, cb):
+                super().__init__()
+                self._cb = cb
+
+            def receive(self, message):
+                self._cb.invoke(message)
+
+        class _L(GraphStageLogic):
+            def pre_start(self):
+                cb = self.get_async_callback(self._on_reply)
+                st["fwd"] = self.materializer.system.actor_of(
+                    Props.create(_Fwd, cb))
+                st["awaiting"] = 1  # the on_init ack gates the first pull
+                stage.ref.tell(stage.on_init, st["fwd"])
+
+            def _on_reply(self, msg):
+                if msg != stage.ack_message:
+                    return  # unrelated chatter to the forwarder
+                st["awaiting"] -= 1
+                if st["awaiting"] > 0:
+                    return
+                if st["finishing"]:
+                    self._close()
+                elif not self.has_been_pulled(in_) and \
+                        not self.is_closed(in_):
+                    self.pull(in_)
+
+            def _close(self):
+                stage.ref.tell(stage.on_complete, st["fwd"])
+                self.set_keep_going(False)
+                self.complete_stage()
+
+            def post_stop(self):
+                if st["fwd"] is not None:
+                    self.materializer.system.stop(st["fwd"])
+
+        logic = _L(self._shape)
+
+        def on_push():
+            st["awaiting"] += 1
+            stage.ref.tell(logic.grab(in_), st["fwd"])
+
+        def on_finish():
+            # on_complete only after every sent element was acked
+            # (reference: the sink completes when the actor has consumed
+            # the whole stream, not merely received it)
+            if st["awaiting"] > 0:
+                st["finishing"] = True
+                logic.set_keep_going(True)  # outlive the closed inlet
+            else:
+                logic._close()
+
+        def on_failure(ex):
+            if stage.on_failure is not None:
+                stage.ref.tell(stage.on_failure(ex), st["fwd"])
+            logic.fail_stage(ex)
+        logic.set_handler(in_, make_in_handler(on_push, on_finish, on_failure))
+        return logic
+
 class CancelledSink(_SinkStage):
     """scaladsl Sink.cancelled: cancel upstream immediately."""
 

@@ -341,6 +341,37 @@ def test_partition_hub_sink_waits_for_first_consumer_by_default(system):
     assert src.run_with(Sink.seq(), system).result(10.0) == [1, 2, 3]
 
 
+# ======================== round-5 sink additions ============================
+
+def test_sink_actor_ref_with_backpressure(system):
+    """init -> ack -> element -> ack -> ... -> on_complete; the consumer
+    actor paces the stream (scaladsl Sink.actorRefWithBackpressure)."""
+    from akka_tpu import Props
+    from akka_tpu.actor.actor import Actor
+    from akka_tpu.testkit import await_condition
+
+    got = []
+
+    class Consumer(Actor):
+        def receive(self, message):
+            got.append(message)
+            if message != "done":
+                self.sender.tell("ack", self.self_ref)
+
+    ref = system.actor_of(Props.create(Consumer), "bp-consumer")
+    Source.from_iterable([1, 2, 3]).run_with(
+        Sink.actor_ref_with_backpressure(ref, "init", "ack", "done"), system)
+    await_condition(lambda: got == ["init", 1, 2, 3, "done"], max_time=10.0,
+                    message=f"conversation wrong: {got}")
+
+
+def test_sink_combine_broadcasts_to_all(system):
+    fut_seq, fut_sum = Source.from_iterable([1, 2, 3, 4]).run_with(
+        Sink.combine(Sink.seq(), Sink.fold(0, lambda a, x: a + x)), system)
+    assert fut_seq.result(10.0) == [1, 2, 3, 4]
+    assert fut_sum.result(10.0) == 10
+
+
 # =============================== JsonFraming ================================
 
 def _frames(chunks, system, max_len=1 << 20):
