@@ -143,6 +143,71 @@ def test_stray_mode_confined_to_handoff_window():
     assert sys.total_dropped == 0
 
 
+def test_repeated_rebalances_cycle_stray_mode_losslessly():
+    """Five successive rebalances, each triggering a grow->forward->drain->
+    shrink cycle of the inbox regridding — the riskiest new path of the
+    r5 stray-mode split. VALUE is the conserved quantity (a delayed token
+    batch merging with the next in a reduce-mode inbox fuses two MESSAGES
+    into one delivery by design — Mailbox.scala reduce semantics — so the
+    behavior forwards the SUM and the invariant is value flow): every
+    steady-state step must deliver the full circulating value, and
+    nothing may drop, across all five cycles."""
+    from akka_tpu.batched import Emit, behavior
+
+    n_shards, eps = 8, 8
+    total_value = float(n_shards * eps)
+
+    @behavior("valfwd", {"val_seen": ((), jnp.float32),
+                         "myshard": ((), jnp.int32),
+                         "myidx": ((), jnp.int32)})
+    def valfwd(state, inbox, ctx):
+        base = ctx.tables["shard_row_base"]
+        nxt = (state["myshard"] + 1) % n_shards
+        return ({"val_seen": state["val_seen"] + inbox.sum[0],
+                 "myshard": state["myshard"], "myidx": state["myidx"]},
+                Emit.single(base[nxt] + state["myidx"], inbox.sum, 1, P,
+                            when=inbox.count > 0))
+
+    region = DeviceShardRegion(DeviceEntity(
+        "reb5", valfwd, n_shards=n_shards, entities_per_shard=eps,
+        n_devices=8, payload_width=P))
+    region.allocate_all()
+    sys = region.system
+    myshard = np.zeros((sys.capacity,), np.int32)
+    myidx = np.zeros((sys.capacity,), np.int32)
+    for s in range(n_shards):
+        base = region.row_of(s, 0)
+        myshard[base:base + eps] = s
+        myidx[base:base + eps] = np.arange(eps)
+    sys.state["myshard"] = sys.state["myshard"].at[:].set(jnp.asarray(myshard))
+    sys.state["myidx"] = sys.state["myidx"].at[:].set(jnp.asarray(myidx))
+    for s in range(n_shards):
+        for i in range(eps):
+            sys.tell(region.row_of(s, i), [1.0, 0, 0, 0])
+    region.run(2)
+
+    def value_seen():
+        return sum(float(sys.read_state(
+            "val_seen", np.arange(region.row_of(s, 0),
+                                  region.row_of(s, 0) + eps,
+                                  dtype=np.int32)).sum())
+            for s in range(n_shards))
+
+    for k in range(5):
+        region.rebalance((k * 3) % n_shards)
+        assert sys.stray_mode is True
+        region.run(6)  # drain (3) + steady (3)
+        region.block_until_ready()
+        assert sys.stray_mode is False, f"cycle {k} never exited"
+        # steady state after the cycle: each step delivers the FULL
+        # circulating value — nothing was lost in grow/forward/shrink
+        before = value_seen()
+        region.run(4)
+        region.block_until_ready()
+        assert value_seen() - before == 4 * total_value, (k, before)
+    assert sys.total_dropped == 0
+
+
 def test_rebalance_moves_state_and_messages():
     n_shards, eps = 8, 8
     fwd = make_forwarder(eps, n_shards)
