@@ -383,6 +383,9 @@ class ShardedBatchedSystem:
         host]; within each pair chunk, received rows are rank-packed at
         the chunk start, so growing pads each chunk's tail and shrinking
         slices it (the caller has verified the tail is empty)."""
+        if new_pair_cap == self.pair_cap:
+            return  # explicit remote_capacity_per_pair: both modes share
+            #         the sizing, the regrid would be a full no-op copy
         ns, sc, hi = self.n_shards, self.spill_cap, self.host_inbox
         old_pc, old_ml = self.pair_cap, self.m_local
         new_ml = sc + ns * new_pair_cap + hi
@@ -436,19 +439,23 @@ class ShardedBatchedSystem:
         forwarded traffic is still in flight on either count."""
         if not self.stray_mode:
             return True
-        ns, sc, ml = self.n_shards, self.spill_cap, self.m_local
-        valid = np.asarray(jax.device_get(self.inbox_valid)).reshape(ns, ml)
-        dst = np.asarray(jax.device_get(self.inbox_dst)).reshape(ns, ml)
+        ns, sc = self.n_shards, self.spill_cap
+        # both predicates reduce ON DEVICE; only two booleans cross to the
+        # host (full-inbox device_gets per drain probe would put two
+        # m_global-row transfers on the rebalance latency path)
+        valid = self.inbox_valid.reshape(ns, self.m_local)
+        dst = self.inbox_dst.reshape(ns, self.m_local)
+        bases = (jnp.arange(ns, dtype=jnp.int32) * self.local_n)[:, None]
         # (a) any valid row addressed outside its hosting shard's range?
-        bases = (np.arange(ns) * self.local_n)[:, None]
-        stray = valid & ((dst < bases) | (dst >= bases + self.local_n))
-        if stray.any():
-            return False
+        has_stray = jnp.any(valid & ((dst < bases) |
+                                     (dst >= bases + self.local_n)))
         # (b) any legit row parked past the base capacity of its chunk?
         pairs_valid = valid[:, sc:sc + ns * self.pair_cap].reshape(
             ns, ns, self.pair_cap)
-        if self.pair_cap_base < self.pair_cap and \
-                pairs_valid[:, :, self.pair_cap_base:].any():
+        tail_occupied = jnp.any(pairs_valid[:, :, self.pair_cap_base:]) \
+            if self.pair_cap_base < self.pair_cap else jnp.asarray(False)
+        if bool(jax.device_get(has_stray)) or \
+                bool(jax.device_get(tail_occupied)):
             return False
         self._relayout_inbox(self.pair_cap_base)
         self.stray_mode = False

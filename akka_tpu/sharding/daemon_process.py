@@ -92,19 +92,16 @@ class ShardedDaemonProcess:
         ids = tuple(str(i) for i in range(number_of_instances))
         key = EntityTypeKey(f"sharded-daemon-process-{name}")
 
-        sharding_settings = settings.sharding_settings or \
+        import dataclasses
+        base = settings.sharding_settings or \
             ClusterShardingSettings(role=settings.role)
         # one shard per instance: the id IS the shard (reference impl's
         # shardId = entityId message extractor), so LeastShardAllocation
-        # spreads and rebalances the workers like any other shards
-        sharding_settings = ClusterShardingSettings(
-            number_of_shards=number_of_instances,
-            buffer_size=sharding_settings.buffer_size,
-            retry_interval=sharding_settings.retry_interval,
-            rebalance_interval=sharding_settings.rebalance_interval,
-            passivate_idle_after=None,  # daemons never passivate
-            remember_entities=sharding_settings.remember_entities,
-            role=sharding_settings.role)
+        # spreads and rebalances the workers like any other shards;
+        # daemons never passivate
+        sharding_settings = dataclasses.replace(
+            base, number_of_shards=number_of_instances,
+            passivate_idle_after=None)
 
         def extract_shard_id(message: Any) -> Optional[str]:
             from .messages import ShardingEnvelope

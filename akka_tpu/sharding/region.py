@@ -121,6 +121,19 @@ class _StateQueryTimeout:
     qid: int
 
 
+@dataclass(frozen=True)
+class _ShardStateQuery:
+    """Region->shard leg of a GetShardRegionState aggregation, tagged so a
+    LATE reply from a timed-out query can never satisfy a newer one."""
+    qid: int
+
+
+@dataclass(frozen=True)
+class _ShardStateReply:
+    qid: int
+    state: Any  # ShardState
+
+
 # per-shard state aggregation deadline (reference: the 5s default ask
 # timeout of ShardRegion.GetShardRegionState queries); a partial snapshot
 # is sent if a shard does not answer in time
@@ -219,6 +232,11 @@ class Shard(Actor):
             self.sender.tell(ShardState(self.shard_id,
                                         tuple(sorted(self.entities))),
                              self.self_ref)
+        elif isinstance(message, _ShardStateQuery):
+            self.sender.tell(_ShardStateReply(
+                message.qid, ShardState(self.shard_id,
+                                        tuple(sorted(self.entities)))),
+                self.self_ref)
         else:
             return NotImplemented
 
@@ -382,24 +400,22 @@ class ShardRegion(Actor):
                     "waiting": set(self.shards), "acc": [],
                     "reply_to": self.sender}
                 for shard in self.shards.values():
-                    shard.tell(GetShardRegionState(), self.self_ref)
+                    shard.tell(_ShardStateQuery(qid), self.self_ref)
                 self.context.system.scheduler.schedule_tell_once(
                     STATE_QUERY_TIMEOUT, self.self_ref,
                     _StateQueryTimeout(qid))
-        elif isinstance(message, ShardState):
-            # a local shard answering a state query: attribute to the
-            # oldest pending query still waiting on that shard id
-            for qid in sorted(self._state_queries):
-                q = self._state_queries[qid]
-                if message.shard_id in q["waiting"]:
-                    q["waiting"].discard(message.shard_id)
-                    q["acc"].append(message)
-                    if not q["waiting"]:
-                        del self._state_queries[qid]
-                        q["reply_to"].tell(
-                            CurrentShardRegionState(tuple(q["acc"])),
-                            self.self_ref)
-                    break
+        elif isinstance(message, _ShardStateReply):
+            # qid-tagged: a late reply from a timed-out query finds its
+            # query gone and is dropped instead of satisfying a newer one
+            q = self._state_queries.get(message.qid)
+            if q is not None and message.state.shard_id in q["waiting"]:
+                q["waiting"].discard(message.state.shard_id)
+                q["acc"].append(message.state)
+                if not q["waiting"]:
+                    del self._state_queries[message.qid]
+                    q["reply_to"].tell(
+                        CurrentShardRegionState(tuple(q["acc"])),
+                        self.self_ref)
         elif isinstance(message, _StateQueryTimeout):
             q = self._state_queries.pop(message.qid, None)
             if q is not None:  # partial beats nothing (reference timeout)

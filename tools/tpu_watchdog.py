@@ -70,9 +70,14 @@ def append_log(rec: dict) -> None:
 def run_logged(name: str, cmd: list[str], timeout_s: float) -> bool:
     t0 = time.time()
     print(f"[watchdog] {name}: {' '.join(cmd)}", flush=True)
+    # STRIP JAX_PLATFORMS exactly like probe(): the cpu-first forcing
+    # workflow exports it, and a capture run inheriting it would produce
+    # CPU numbers committed as TPU artifacts — the opposite of the tool's
+    # purpose
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
     try:
         r = subprocess.run(cmd, cwd=REPO, timeout=timeout_s,
-                           capture_output=True, text=True)
+                           capture_output=True, text=True, env=env)
     except subprocess.TimeoutExpired:
         append_log({"ts": _utcnow(), "ok": False,
                     "detail": f"{name} timed out after {timeout_s:.0f}s"})
@@ -90,15 +95,30 @@ def run_logged(name: str, cmd: list[str], timeout_s: float) -> bool:
 
 def git_commit(paths: list[str], msg: str) -> None:
     """Commit artifacts; retry briefly if the builder session holds the
-    index (both sides commit fast, so contention clears in seconds)."""
+    index (both sides commit fast, so contention clears in seconds).
+    Missing paths are filtered first — a bad pathspec would abort the
+    whole `git add` and silently commit nothing."""
+    existing = [p for p in paths
+                if os.path.exists(os.path.join(REPO, p))]
+    if not existing:
+        append_log({"ts": _utcnow(), "ok": False,
+                    "detail": "git_commit: no artifacts exist to commit"})
+        return
     for attempt in range(5):
-        subprocess.run(["git", "add", "-f", *paths], cwd=REPO,
-                       capture_output=True)
+        add = subprocess.run(["git", "add", "-f", *existing], cwd=REPO,
+                             capture_output=True, text=True)
+        if add.returncode != 0:
+            append_log({"ts": _utcnow(), "ok": False,
+                        "detail": f"git add failed: {add.stderr[:200]}"})
+            time.sleep(3.0 * (attempt + 1))
+            continue
         r = subprocess.run(["git", "commit", "-m", msg], cwd=REPO,
                            capture_output=True, text=True)
         if r.returncode == 0 or "nothing to commit" in r.stdout:
             return
         time.sleep(3.0 * (attempt + 1))
+    append_log({"ts": _utcnow(), "ok": False,
+                "detail": "git_commit: all attempts failed"})
 
 
 def on_tpu_found(detail: str) -> None:
@@ -125,7 +145,6 @@ def on_tpu_found(detail: str) -> None:
     run_logged("attrib", [sys.executable, "tools/attrib_dynamic.py",
                           "--actors", str(1 << 20), "--json"],
                timeout_s=1800)
-    attrib_out = os.path.join(REPO, "watchdog_attrib.out")
     run_logged("trace", [sys.executable, "bench.py", "--config",
                          "ring-dynamic", "--trace", "traces/tpu_r05",
                          "--probe-timeout", "120"],
