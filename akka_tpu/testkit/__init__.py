@@ -10,5 +10,6 @@ from .behavior_testkit import (BehaviorTestKit, TestInbox, Effect,  # noqa: F401
                                MessageAdapter)
 from .manual_time import ManualTimeScheduler, install_manual_time  # noqa: F401
 from .event_filter import LoggingTestKit  # noqa: F401
+from .sharding import region_entity_ids  # noqa: F401
 from .multi_node import (MultiNodeKit, NodeHandle, TestConductor,  # noqa: F401
                          BarrierTimeout)

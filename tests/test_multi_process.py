@@ -236,6 +236,88 @@ system.terminate(); system.await_termination(10)
     assert results[2]["up"] <= 1  # never admitted
 
 
+def test_sharded_daemon_process_rehomes_across_real_processes():
+    """ShardedDaemonProcess through the REAL multi-process harness
+    (VERDICT r4 #7 done-criterion): N always-alive workers spread over two
+    OS processes; when the second process dies mid-run, the keep-alive
+    pinger revives every index on the survivor — singleton-per-index
+    throughout (reference: ShardedDaemonProcessImpl keep-alive +
+    one-shard-per-instance design)."""
+    worker = _COMMON + r"""
+from akka_tpu.sharding import (ShardedDaemonProcess,
+                               ShardedDaemonProcessSettings)
+from akka_tpu.typed import Behaviors
+from akka_tpu.testkit import TestProbe
+
+system = make_system({"akka": {"cluster": {
+    "split-brain-resolver": {"active-strategy": "keep-majority",
+                             "stable-after": dilated_s(1.0)},
+    "down-removal-margin": dilated_s(0.5)}}})
+seed = f"akka://mp0@127.0.0.1:{BASE_PORT}"
+node_barrier("boot")
+Cluster.get(system).join(seed)
+await_(lambda: up_count(system) == 2, 40, "2 members Up")
+node_barrier("converged")
+
+NWORK = 4
+def factory(i):
+    return Behaviors.setup(lambda ctx: Behaviors.receive(
+        lambda c, m: Behaviors.same()))
+
+region = ShardedDaemonProcess.get(system).init(
+    "mp-daemons", NWORK, factory,
+    settings=ShardedDaemonProcessSettings(keep_alive_interval=0.3))
+probe = TestProbe(system)
+from akka_tpu.testkit import region_entity_ids
+
+def local_ids():
+    return region_entity_ids(region, probe)
+
+all_ids = {str(i) for i in range(NWORK)}
+# report the value an await_ CONFIRMED, never a fresh one-shot re-query
+# (a single GetShardRegionState may legitimately return a partial
+# snapshot at the region's own aggregation timeout)
+confirmed = {}
+if IDX == 0:
+    # wait until the workers are spread: node 1 hosts at least one
+    def spread():
+        mine = local_ids()
+        return mine and mine != all_ids
+    await_(spread, 40, "workers never spread to the second node")
+    node_barrier("spread")
+    # no further barriers: node 1 dies abruptly after this point and a
+    # barrier would wait for it forever. Every index must rehome here.
+    def rehomed():
+        mine = local_ids()
+        if mine == all_ids:
+            confirmed["ids"] = mine
+            return True
+        return False
+    await_(rehomed, 60, "workers did not rehome to the survivor")
+    await_(lambda: up_count(system) == 1, 60, "dead node never removed")
+    node_result({"side": "survivor", "ids": sorted(confirmed["ids"])})
+    system.terminate(); system.await_termination(10)
+else:
+    def hosted_some():
+        mine = local_ids()
+        if mine:
+            confirmed["hosted"] = mine
+            return True
+        return False
+    await_(hosted_some, 40, "no workers ever landed here")
+    node_barrier("spread")
+    node_result({"side": "leaver", "hosted": sorted(confirmed["hosted"])})
+    # die ABRUPTLY (no graceful leave): the cluster must down us and the
+    # daemons must rehome via the keep-alive pinger
+    os._exit(0)
+"""
+    results, _ = spawn_nodes(worker, 2, timeout=240.0,
+                             extra_env={"AKKA_TPU_TEST_BASE_PORT": "23560"})
+    assert results[0]["side"] == "survivor"
+    assert results[0]["ids"] == ["0", "1", "2", "3"]
+    assert results[1]["hosted"]  # the leaver really hosted workers first
+
+
 def test_remote_tell_across_real_processes():
     worker = _COMMON + r"""
 from akka_tpu import Actor, Props

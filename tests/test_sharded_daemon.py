@@ -12,7 +12,7 @@ from akka_tpu import ActorSystem
 from akka_tpu.cluster import Cluster
 from akka_tpu.remote.transport import InProcTransport
 from akka_tpu.sharding import (ClusterShardingSettings, ClusterShardingTyped,
-                               EntityTypeKey, GetShardRegionState,
+                               EntityTypeKey,
                                ShardedDaemonProcess,
                                ShardedDaemonProcessSettings)
 from akka_tpu.testkit import TestProbe, await_condition
@@ -97,24 +97,8 @@ def test_crashed_instance_is_revived_by_keep_alive(one_node):
 
 
 def _region_entities(region, probe):
-    """Poll-safe state read: drain stale replies first (a previous poll's
-    late answer must not desync this one), outlast the region's internal
-    aggregation timeout, and report None (falsy) on a miss so
-    await_condition retries instead of erroring."""
-    while True:
-        try:
-            probe.receive_one(0.01)
-        except AssertionError:
-            break
-    region.tell(GetShardRegionState(), probe.ref)
-    try:
-        state = probe.receive_one(4.0)  # > region STATE_QUERY_TIMEOUT (2s)
-    except AssertionError:
-        return None
-    ids = set()
-    for shard in state.shards:
-        ids |= set(shard.entity_ids)
-    return ids
+    from akka_tpu.testkit import region_entity_ids
+    return region_entity_ids(region, probe)
 
 
 def test_workers_rehome_across_leave_and_join():
