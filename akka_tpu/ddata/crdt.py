@@ -264,6 +264,101 @@ class GSet(DeltaReplicatedData, Generic[A]):
         return f"GSet({set(self.elements)!r})"
 
 
+class ORSetDeltaOp:
+    """Op-based ORSet delta algebra (reference: ORSet.scala:55-110
+    AddDeltaOp/RemoveDeltaOp/FullStateDeltaOp/DeltaGroup): an update ships
+    only the touched element + its dot, not the whole set. Ops merge into
+    groups between propagation ticks; consecutive same-node adds coalesce."""
+
+    __slots__ = ()
+
+    def zero(self) -> "ORSet":
+        """Empty full state to apply a delta against on a replica that has
+        never seen the key (reference: ReplicatedDelta.zero)."""
+        return ORSet()
+
+    def merge(self, that: "ORSetDeltaOp") -> "ORSetDeltaOp":
+        if isinstance(that, ORSetDeltaGroup):
+            return ORSetDeltaGroup((self,) + that.ops)
+        return ORSetDeltaGroup((self, that))
+
+    def __eq__(self, other):
+        return type(self) is type(other) and \
+            self.underlying == other.underlying  # type: ignore[attr-defined]
+
+    def __hash__(self):
+        return hash((type(self).__name__,
+                     self.underlying))  # type: ignore[attr-defined]
+
+
+class ORSetAddDeltaOp(ORSetDeltaOp):
+    """underlying: ONLY the added element(s) with their fresh dots; its
+    vvector is just those dots (tiny on the wire)."""
+
+    __slots__ = ("underlying",)
+
+    def __init__(self, underlying: "ORSet"):
+        self.underlying = underlying
+
+    def merge(self, that: ORSetDeltaOp) -> ORSetDeltaOp:
+        if isinstance(that, ORSetAddDeltaOp):
+            # consecutive adds from the SAME node coalesce into one op
+            new_map = dict(self.underlying.element_map)
+            new_map.update(that.underlying.element_map)
+            return ORSetAddDeltaOp(ORSet(
+                new_map,
+                self.underlying.vvector.merge(that.underlying.vvector)))
+        return super().merge(that)
+
+
+class ORSetRemoveDeltaOp(ORSetDeltaOp):
+    """underlying: exactly ONE removed element with the remover's dot; its
+    vvector is the remover's FULL causal context (the remove only wins over
+    adds it observed)."""
+
+    __slots__ = ("underlying",)
+
+    def __init__(self, underlying: "ORSet"):
+        if len(underlying.element_map) != 1:
+            raise ValueError(
+                f"RemoveDeltaOp must contain one removed element, "
+                f"got {len(underlying.element_map)}")
+        self.underlying = underlying
+
+
+class ORSetFullStateDeltaOp(ORSetDeltaOp):
+    """Fallback op carrying full state (clear(), and mixed histories)."""
+
+    __slots__ = ("underlying",)
+
+    def __init__(self, underlying: "ORSet"):
+        self.underlying = underlying
+
+
+class ORSetDeltaGroup(ORSetDeltaOp):
+    """Ordered batch of atomic ops between propagation ticks."""
+
+    __slots__ = ("ops",)
+
+    def __init__(self, ops):
+        self.ops = tuple(ops)
+
+    def merge(self, that: ORSetDeltaOp) -> ORSetDeltaOp:
+        if isinstance(that, ORSetAddDeltaOp) and self.ops and \
+                isinstance(self.ops[-1], ORSetAddDeltaOp):
+            return ORSetDeltaGroup(
+                self.ops[:-1] + (self.ops[-1].merge(that),))
+        if isinstance(that, ORSetDeltaGroup):
+            return ORSetDeltaGroup(self.ops + that.ops)
+        return ORSetDeltaGroup(self.ops + (that,))
+
+    def __eq__(self, other):
+        return isinstance(other, ORSetDeltaGroup) and self.ops == other.ops
+
+    def __hash__(self):
+        return hash(self.ops)
+
+
 class ORSet(DeltaReplicatedData, RemovedNodePruning, Generic[A]):
     """Observed-remove set, add-wins on concurrent add/remove.
 
@@ -272,8 +367,10 @@ class ORSet(DeltaReplicatedData, RemovedNodePruning, Generic[A]):
     `vvector` that records every event the whole set has seen. Merge keeps
     an element present on one side iff its dot is NOT dominated by the other
     side's vvector (i.e. the other side saw the add and deleted it).
-    Deltas here are full-state (correct, since ORSet merge is idempotent);
-    the reference's op-based AddDeltaOp/RemoveDeltaOp is an optimisation.
+    Deltas are OP-BASED (r5; previously full-state): add ships only the
+    element + fresh dot, remove ships the element + the remover's causal
+    context, clear ships full state — the AddDeltaOp/RemoveDeltaOp/
+    FullStateDeltaOp/DeltaGroup algebra of ORSet.scala:55-110,334-410.
     """
 
     __slots__ = ("element_map", "vvector", "_delta")
@@ -299,28 +396,42 @@ class ORSet(DeltaReplicatedData, RemovedNodePruning, Generic[A]):
     def __contains__(self, e: A) -> bool:
         return e in self.element_map
 
+    def _push_delta(self, op: ORSetDeltaOp) -> ORSetDeltaOp:
+        return op if self._delta is None else self._delta.merge(op)
+
     def add(self, node: str, e: A) -> "ORSet[A]":
         vv = self.vvector.increment(node)
         dot = VersionVector.one(node, vv.version_at(node))
         new = dict(self.element_map)
         new[e] = dot  # fresh dot replaces observed history for e (ORSet.scala add)
-        return ORSet(new, vv, _delta=ORSet(dict(new), vv))
+        op = ORSetAddDeltaOp(ORSet({e: dot}, dot))
+        return ORSet(new, vv, _delta=self._push_delta(op))
 
     def remove(self, node: str, e: A) -> "ORSet[A]":
         new = dict(self.element_map)
         new.pop(e, None)
-        # delta must carry the full causal context so the remove wins over
-        # the adds it observed
-        return ORSet(new, self.vvector, _delta=ORSet(dict(new), self.vvector))
+        # the op carries the remover's FULL causal context so the remove
+        # wins exactly over the adds it observed (ORSet.scala:382)
+        delta_dot = VersionVector.one(node, self.vvector.version_at(node))
+        op = ORSetRemoveDeltaOp(ORSet({e: delta_dot}, self.vvector))
+        return ORSet(new, self.vvector, _delta=self._push_delta(op))
 
     def clear(self) -> "ORSet[A]":
-        return ORSet({}, self.vvector, _delta=ORSet({}, self.vvector))
+        op = ORSetFullStateDeltaOp(ORSet({}, self.vvector))
+        return ORSet({}, self.vvector, _delta=self._push_delta(op))
 
     @staticmethod
     def _merge_dots(d1: VersionVector, d2: VersionVector) -> VersionVector:
         return d1.merge(d2)
 
     def merge(self, other: "ORSet[A]") -> "ORSet[A]":
+        return self._dry_merge(other, add_delta=False)
+
+    def _dry_merge(self, other: "ORSet[A]", add_delta: bool) -> "ORSet[A]":
+        """Full merge; with add_delta=True, THIS side's unique elements are
+        kept unconditionally — an AddDeltaOp's tiny vvector records only
+        the new dots, so checking our elements against it would wrongly
+        delete everything it has not seen (ORSet.scala:434-453 dryMerge)."""
         merged: Dict[A, VersionVector] = {}
         for e in set(self.element_map) | set(other.element_map):
             mine, theirs = self.element_map.get(e), other.element_map.get(e)
@@ -329,7 +440,7 @@ class ORSet(DeltaReplicatedData, RemovedNodePruning, Generic[A]):
             elif mine is not None:
                 # present only here: keep iff other hasn't observed (and
                 # hence removed) every event in the dot
-                if not self._dominated(mine, other.vvector):
+                if add_delta or not self._dominated(mine, other.vvector):
                     merged[e] = mine
             else:
                 if not self._dominated(theirs, self.vvector):  # type: ignore[arg-type]
@@ -347,8 +458,39 @@ class ORSet(DeltaReplicatedData, RemovedNodePruning, Generic[A]):
     def reset_delta(self) -> "ORSet[A]":
         return ORSet(self.element_map, self.vvector)
 
-    def merge_delta(self, delta: "ORSet[A]") -> "ORSet[A]":
+    def merge_delta(self, delta) -> "ORSet[A]":
+        """Apply an op-based delta (ORSet.scala:455-469 mergeDelta); a
+        plain ORSet (pre-r5 full-state delta) still full-merges."""
+        if isinstance(delta, ORSetAddDeltaOp):
+            return self._dry_merge(delta.underlying, add_delta=True)
+        if isinstance(delta, ORSetRemoveDeltaOp):
+            return self._merge_remove_delta(delta)
+        if isinstance(delta, ORSetFullStateDeltaOp):
+            return self._dry_merge(delta.underlying, add_delta=False)
+        if isinstance(delta, ORSetDeltaGroup):
+            acc = self
+            for op in delta.ops:
+                if isinstance(op, ORSetDeltaGroup):
+                    raise ValueError("ORSet DeltaGroup must not be nested")
+                acc = acc.merge_delta(op)
+            return acc
         return self.merge(delta)
+
+    def _merge_remove_delta(self, delta: ORSetRemoveDeltaOp) -> "ORSet[A]":
+        """(reference: ORSet.scala:471-501 mergeRemoveDelta) — drop the
+        element iff the remover's causal context covers every add event in
+        OUR dot for it; always merge the remover's dot into the vvector so
+        the removal event itself is recorded."""
+        that = delta.underlying
+        (elem, that_dot), = that.element_map.items()
+        new = dict(self.element_map)
+        mine = new.get(elem)
+        # drop iff OUR dot is dominated by the remover's causal context —
+        # the canonical domination predicate (a node of ours absent from
+        # the context makes it false, i.e. a concurrent unseen add wins)
+        if mine is not None and self._dominated(mine, that.vvector):
+            del new[elem]
+        return ORSet(new, self.vvector.merge(that_dot), self._delta)
 
     def modified_by_nodes(self) -> FrozenSet[str]:
         return frozenset(self.vvector.nodes())

@@ -262,12 +262,26 @@ class _Gossip:
     want_keys: Tuple[str, ...]  # keys the sender lacks and wants back
     from_addr: str
     tombstones: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+    # sender's per-key delta sequence at send time: a full state entry
+    # covers every op up to this seq, so the receiver resyncs its
+    # (origin, key) delta cursor and resumes op-based deltas after a gap
+    # (reference: delta versions riding the gossiped DataEnvelope)
+    delta_seq: Dict[str, int] = field(default_factory=dict)
+    origin_uid: str = ""   # sender replicator incarnation (cursor scope)
 
 
 @dataclass(frozen=True)
 class _DeltaPropagation:
+    """deltas: key -> (seq, delta). Op-based deltas are only safe under
+    CAUSAL delivery — the per-(origin, key) sequence number lets receivers
+    detect a gap (dropped tick, late join) and fall back to full-state
+    gossip instead of applying an op whose causal context they miss
+    (reference: DeltaPropagationSelector seqNr discipline; applying a
+    gapped ORSet op poisons the vvector and deletes the missed elements
+    cluster-wide)."""
     deltas: Dict[str, Any]
     from_addr: str
+    origin_uid: str = ""   # sender replicator incarnation (cursor scope)
 
 
 @dataclass(frozen=True)
@@ -391,6 +405,16 @@ class Replicator(Actor):
         self.changed_keys: Set[str] = set()
         self.pending: Dict[str, _PendingReq] = {}
         self.deltas: Dict[str, Any] = {}  # key -> accumulated delta for peers
+        self.delta_seq: Dict[str, int] = {}        # key -> my last sent seq
+        # delta cursors key on the origin's INCARNATION, not its bare
+        # address: a restarted origin's fresh seq stream (1, 2, ...) would
+        # otherwise be swallowed as duplicates by the old cursor and its
+        # first genuinely-applied op would smuggle the unseen events'
+        # vvector in — precisely the poisoning the gap guard prevents
+        self._delta_incarnation = uuid.uuid4().hex
+        self._delta_seen: Dict[Tuple[str, str, str], int] = {}
+        self._delta_gapped: set = set()   # (origin, origin_uid, key)
+        self._origin_uid: Dict[str, str] = {}  # origin addr -> last uid
         # key -> {pruned node id -> prune time}; incoming merges are cleaned
         # against these so stale gossip can't resurrect a removed node's
         # entries (reference: PruningState tombstones); expired after
@@ -619,18 +643,44 @@ class Replicator(Actor):
         elif isinstance(message, _Gossip):
             self._handle_gossip(message)
         elif isinstance(message, _DeltaPropagation):
-            for key, delta in message.deltas.items():
+            origin, uid = message.from_addr, message.origin_uid
+            if self._origin_uid.get(origin) != uid:
+                # new origin incarnation: its old cursors are dead weight
+                # (and must never swallow the fresh stream as duplicates)
+                self._drop_delta_cursors(origin=origin)
+                self._origin_uid[origin] = uid
+            for key, entry in message.deltas.items():
+                seq, delta = entry
+                ok_pair = (origin, uid, key)
+                if ok_pair in self._delta_gapped:
+                    continue  # full-state gossip owns this key from origin
+                seen = self._delta_seen.get(ok_pair, 0)
+                if seq <= seen:
+                    continue  # duplicate/old tick
+                if seq != seen + 1:
+                    # GAP: applying an op whose causal context we miss
+                    # would poison the vvector (delete the missed ops'
+                    # elements everywhere). Drop, and let digest gossip
+                    # carry this key until a full state resyncs the cursor
+                    self._delta_gapped.add(ok_pair)
+                    continue
                 cur = self.data.get(key)
                 if cur == DELETED:
-                    continue
+                    continue  # no cursor bumps for dead keys
                 if cur is None:
-                    self._merge_in(key, delta)
+                    # first sight of the key via a delta: op-based deltas
+                    # apply against their zero (ReplicatedDelta.zero);
+                    # full-state deltas ARE data
+                    zero = getattr(delta, "zero", None)
+                    self._merge_in(key, zero().merge_delta(delta)
+                                   if zero is not None else delta)
                 elif isinstance(cur, DeltaReplicatedData):
                     merged = cur.merge_delta(delta)
                     if merged != cur:
                         self._set_data(key, merged)
                 else:
                     self._merge_in(key, delta)
+                self._delta_seen[ok_pair] = seq
         elif isinstance(message, _Read):
             self.sender.tell(_ReadResult(message.req_id,
                                          self.data.get(message.key)),
@@ -658,6 +708,9 @@ class Replicator(Actor):
             self._merge_in(message.key, message.data)
         elif isinstance(message, MemberRemoved):
             self.removed_nodes.add(unique_node_id(message.member.unique_address))
+            gone = str(message.member.unique_address.address)
+            self._drop_delta_cursors(origin=gone)
+            self._origin_uid.pop(gone, None)
         elif isinstance(message, MemberEvent):
             pass
         else:
@@ -729,6 +782,8 @@ class Replicator(Actor):
             return
         self._set_data(key, DELETED)
         self.deltas.pop(key, None)
+        self.delta_seq.pop(key, None)
+        self._drop_delta_cursors(key=key)
         nodes = self._nodes()
         needed = self._required_acks(msg.consistency, len(nodes) + 1)
         if needed == 0:
@@ -814,7 +869,9 @@ class Replicator(Actor):
         if to_send or missing:
             self._replicator_at(msg.from_addr).tell(
                 _Gossip(to_send, want_keys=missing, from_addr=self.self_addr,
-                        tombstones=self._tombstones_wire()),
+                        tombstones=self._tombstones_wire(),
+                        delta_seq=self._delta_seq_for(to_send),
+                        origin_uid=self._delta_incarnation),
                 self.self_ref)
 
     def _handle_gossip(self, msg: _Gossip) -> None:
@@ -831,13 +888,42 @@ class Replicator(Actor):
                     self._set_data(k, cleaned, notify=False)
         for k, v in msg.entries.items():
             self._merge_in(k, v)
+            if k in msg.delta_seq and msg.origin_uid:
+                # the full state covers every op of the sender up to this
+                # seq: resync the delta cursor and resume op-based deltas
+                # (duplicate re-application is safe — CRDT merges are
+                # idempotent; only GAPS are dangerous)
+                if self._origin_uid.get(msg.from_addr) != msg.origin_uid:
+                    self._drop_delta_cursors(origin=msg.from_addr)
+                    self._origin_uid[msg.from_addr] = msg.origin_uid
+                pair = (msg.from_addr, msg.origin_uid, k)
+                self._delta_seen[pair] = max(
+                    self._delta_seen.get(pair, 0), msg.delta_seq[k])
+                self._delta_gapped.discard(pair)
         if msg.want_keys:
             back = {k: self.data[k] for k in msg.want_keys if k in self.data}
             if back:
                 self._replicator_at(msg.from_addr).tell(
                     _Gossip(back, want_keys=(), from_addr=self.self_addr,
-                            tombstones=self._tombstones_wire()),
+                            tombstones=self._tombstones_wire(),
+                            delta_seq=self._delta_seq_for(back),
+                            origin_uid=self._delta_incarnation),
                     self.self_ref)
+
+    def _delta_seq_for(self, entries: Dict[str, Any]) -> Dict[str, int]:
+        return {k: self.delta_seq[k] for k in entries if k in self.delta_seq}
+
+    def _drop_delta_cursors(self, origin: Optional[str] = None,
+                            key: Optional[str] = None) -> None:
+        """Prune delta bookkeeping: by origin (node removed / new
+        incarnation) or by key (deleted) — the cursors must not grow with
+        cluster/key churn."""
+        def dead(pair) -> bool:
+            return (origin is not None and pair[0] == origin) or \
+                (key is not None and pair[2] == key)
+        for pair in [p for p in self._delta_seen if dead(p)]:
+            del self._delta_seen[pair]
+        self._delta_gapped = {p for p in self._delta_gapped if not dead(p)}
 
     def _tombstones_wire(self) -> Dict[str, Tuple[str, ...]]:
         return {k: tuple(v) for k, v in self.pruned.items()}
@@ -847,10 +933,14 @@ class Replicator(Actor):
             return
         nodes = self._nodes()
         if nodes:
-            payload = dict(self.deltas)
+            payload = {}
+            for k, d in self.deltas.items():
+                self.delta_seq[k] = self.delta_seq.get(k, 0) + 1
+                payload[k] = (self.delta_seq[k], d)
             for addr in nodes:
                 self._replicator_at(addr).tell(
-                    _DeltaPropagation(payload, self.self_addr), self.self_ref)
+                    _DeltaPropagation(payload, self.self_addr,
+                                      self._delta_incarnation), self.self_ref)
         self.deltas.clear()
 
     # -- pruning (simplified leader-driven collapse) -------------------------

@@ -382,3 +382,115 @@ def test_replicator_prunes_removed_node_without_double_count(ddata_nodes):
                       and gone not in m.data.modified_by_nodes())
         return all(ok)
     await_condition(pruned_everywhere, max_time=15.0)
+
+
+# -- op-based ORSet deltas (r5; reference: ORSet.scala:55-110,334-501) --------
+
+def test_orset_add_delta_ships_only_the_touched_element():
+    from akka_tpu.ddata.crdt import ORSet, ORSetAddDeltaOp
+    s = ORSet.empty()
+    for e in ("a", "b", "c", "d", "e"):
+        s = s.add("n1", e).reset_delta()
+    s2 = s.add("n1", "f")
+    op = s2.delta
+    assert isinstance(op, ORSetAddDeltaOp)
+    # the op carries ONE element + its dot, not the 6-element set
+    assert set(op.underlying.element_map) == {"f"}
+    assert list(op.underlying.vvector.nodes()) == ["n1"]
+    # a replica applies it and converges with the full state
+    replica = s.reset_delta()
+    assert replica.merge_delta(op).elements == s2.elements
+
+
+def test_orset_consecutive_adds_coalesce_into_one_op():
+    from akka_tpu.ddata.crdt import ORSet, ORSetAddDeltaOp
+    s = ORSet.empty().add("n1", "x").add("n1", "y").add("n1", "z")
+    op = s.delta
+    assert isinstance(op, ORSetAddDeltaOp)  # one op, not a group of three
+    assert set(op.underlying.element_map) == {"x", "y", "z"}
+    assert ORSet.empty().merge_delta(op).elements == {"x", "y", "z"}
+
+
+def test_orset_remove_delta_wins_only_over_observed_adds():
+    from akka_tpu.ddata.crdt import ORSet
+    a = ORSet.empty().add("n1", "e").reset_delta()
+    b = a  # replica
+    # n1 removes e; CONCURRENTLY n2 re-adds e on its replica
+    removed = a.remove("n1", "e")
+    rm_op = removed.delta
+    readded = b.add("n2", "e").reset_delta()
+    # the remove only observed n1's add: applying it to the replica that
+    # saw a CONCURRENT re-add keeps the element (add-wins)
+    after = readded.merge_delta(rm_op)
+    assert "e" in after.elements
+    # but a replica with no concurrent add drops it
+    assert "e" not in b.merge_delta(rm_op).elements
+
+
+def test_orset_mixed_ops_group_in_order():
+    from akka_tpu.ddata.crdt import ORSet, ORSetDeltaGroup
+    s = ORSet.empty().add("n1", "x").remove("n1", "x").add("n1", "y")
+    group = s.delta
+    assert isinstance(group, ORSetDeltaGroup)
+    applied = ORSet.empty().merge_delta(group)
+    assert applied.elements == {"y"}  # x added then removed, y stays
+
+
+def test_orset_delta_first_sight_applies_against_zero():
+    """A replica that has never seen the key gets the op-based delta and
+    applies it against ReplicatedDelta.zero semantics."""
+    from akka_tpu.ddata.crdt import ORSet
+    s = ORSet.empty().add("n1", "only")
+    op = s.delta
+    fresh = op.zero().merge_delta(op)
+    assert fresh.elements == {"only"}
+
+
+def test_orset_clear_ships_full_state_op():
+    from akka_tpu.ddata.crdt import ORSet, ORSetFullStateDeltaOp
+    base = ORSet.empty().add("n1", "a").add("n1", "b").reset_delta()
+    stale = base  # a true replica shares the causal history
+    cleared = base.clear()
+    op = cleared.delta
+    assert isinstance(op, ORSetFullStateDeltaOp)
+    assert stale.merge_delta(op).elements == frozenset()
+
+
+def test_delta_gap_falls_back_to_gossip_without_data_loss(ddata_nodes):
+    """The op-delta causal guard (code-review r5 finding): a replica that
+    MISSES a delta tick must not apply the next op (the op's vvector would
+    claim the missed events and delete their elements cluster-wide);
+    instead it drops gapped ops and converges via full-state gossip —
+    every element survives on every node."""
+    from akka_tpu.ddata.replicator import _DeltaPropagation
+    systems, dd = ddata_nodes
+    key = Key("gapset")
+    probe = TestProbe(systems[0])
+    me = _node_id(systems[0])
+    dd[0].replicator.tell(
+        Update(key, ORSet.empty(), WriteLocal(),
+               modify=lambda s: s.add(me, "a")), probe.ref)
+    probe.fish_for_message(lambda m: isinstance(m, UpdateSuccess), 5.0)
+    # forge the gap on node 1: inject a delta claiming seq 2 from node 0
+    # BEFORE node 1 ever saw seq 1 (as if the first tick was dropped)
+    s_b = ORSet.empty().add(me, "x").reset_delta().add(me, "b")
+    dd[1].replicator.tell(
+        _DeltaPropagation({key.id: (99, s_b.delta)},  # seq 99: a huge gap
+                          from_addr=str(systems[0].provider.local_address),
+                          origin_uid="forged-origin"),
+        dd[0].replicator)
+
+    # node 1 must NEVER apply the gapped op (no b, no x), and gossip must
+    # still converge the real element 'a' — nothing lost, nothing forged
+    def state_on_1():
+        p = TestProbe(systems[1])
+        dd[1].replicator.tell(Get(key, ReadLocal()), p.ref)
+        try:
+            got = p.receive_one(1.0)
+        except AssertionError:
+            return None
+        return got.data.elements if isinstance(got, GetSuccess) else None
+
+    await_condition(lambda: state_on_1() == frozenset({"a"}), max_time=10.0,
+                    message=f"expected exactly {{'a'}}: {state_on_1()}")
+    assert state_on_1() == frozenset({"a"})  # gapped op never applied
