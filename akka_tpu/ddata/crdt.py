@@ -614,7 +614,14 @@ class LWWRegister(ReplicatedData, Generic[A]):
 
 class ORMap(DeltaReplicatedData, RemovedNodePruning, Generic[A]):
     """Observed-remove map: ORSet of keys + per-key ReplicatedData values
-    merged recursively (reference: ORMap.scala)."""
+    merged recursively (reference: ORMap.scala).
+
+    Deliberate deviation: ORMap deltas are FULL-STATE snapshots (correct —
+    merge is idempotent — but not bandwidth-minimal), while ORSet ships
+    op-based deltas. The reference's ORMap Put/Update/Remove delta algebra
+    (ORMap.scala:30-110, zero-tag value reconstruction) is an optimisation
+    layered on the same causal-delivery discipline the replicator now
+    enforces; the seam to add it later is merge_delta below."""
 
     __slots__ = ("keys", "entries", "_delta")
 
