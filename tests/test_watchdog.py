@@ -1,0 +1,124 @@
+"""TPU watchdog unit tests: the on-success capture path has to work the
+ONE time it fires (a wedged tunnel means it may never run before the
+round ends — these tests execute it with mocked subprocesses so a revived
+tunnel cannot hit a broken capture)."""
+
+import json
+import os
+import subprocess
+
+import pytest
+
+
+@pytest.fixture()
+def watchdog(tmp_path, monkeypatch):
+    monkeypatch.syspath_prepend(
+        os.path.join(os.path.dirname(__file__), "..", "tools"))
+    # isolate from the user's git config (gpgsign/hooksPath would make the
+    # scratch repo's commits fail spuriously)
+    monkeypatch.setenv("GIT_CONFIG_GLOBAL", os.devnull)
+    monkeypatch.setenv("GIT_CONFIG_SYSTEM", os.devnull)
+    import tpu_watchdog as wd
+    # point the module at a scratch repo
+    monkeypatch.setattr(wd, "REPO", str(tmp_path))
+    monkeypatch.setattr(wd, "LOG", str(tmp_path / "TPU_PROBELOG.jsonl"))
+    subprocess.run(["git", "init", "-q", str(tmp_path)], check=True)
+    subprocess.run(["git", "-C", str(tmp_path), "config",
+                    "user.email", "t@t"], check=True)
+    subprocess.run(["git", "-C", str(tmp_path), "config",
+                    "user.name", "t"], check=True)
+    yield wd, tmp_path
+
+
+def test_probe_strips_jax_platforms(watchdog, monkeypatch):
+    wd, _ = watchdog
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    seen = {}
+
+    def fake_run(cmd, **kw):
+        seen["env"] = kw.get("env")
+
+        class R:
+            returncode = 0
+            stdout = "tpu v5e 1\n"
+            stderr = ""
+        return R()
+
+    monkeypatch.setattr(wd.subprocess, "run", fake_run)
+    ok, detail = wd.probe(5.0)
+    assert ok and detail == "tpu v5e 1"
+    assert "JAX_PLATFORMS" not in seen["env"]
+
+
+def test_capture_runs_strip_jax_platforms_too(watchdog, monkeypatch):
+    """The round-5 review finding: a capture inheriting the cpu-forcing
+    env would commit CPU numbers labeled TPU."""
+    wd, tmp = watchdog
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    seen = {}
+
+    def fake_run(cmd, **kw):
+        seen["env"] = kw.get("env")
+
+        class R:
+            returncode = 0
+            stdout = '{"metric": "m", "value": 1}\n'
+            stderr = ""
+        return R()
+
+    monkeypatch.setattr(wd.subprocess, "run", fake_run)
+    assert wd.run_logged("bench_full", ["echo", "x"], timeout_s=5.0)
+    assert "JAX_PLATFORMS" not in seen["env"]
+    # the stdout was persisted for the artifact parse
+    assert (tmp / "watchdog_bench_full.out").exists()
+
+
+def test_on_tpu_found_writes_and_commits_artifacts(watchdog, monkeypatch):
+    wd, tmp = watchdog
+
+    def fake_run_logged(name, cmd, timeout_s):
+        out = tmp / f"watchdog_{name}.out"
+        if name == "bench_full":
+            # two JSON lines: the LAST (the cumulative summary bench.py
+            # prints after every config) must win the artifact parse
+            out.write_text('noise\n{"metric": "partial", "value": 7}\n'
+                           '{"metric": "tpu ring", "value": 42, '
+                           '"unit": "msgs/sec"}\n--- stderr ---\n')
+        else:
+            out.write_text("ok\n--- stderr ---\n")
+        return True
+
+    monkeypatch.setattr(wd, "run_logged", fake_run_logged)
+    wd.on_tpu_found("tpu v5e 8")
+    bench = json.loads((tmp / "BENCH_TPU.json").read_text())
+    assert bench["value"] == 42  # LAST json line wins
+    log = subprocess.run(["git", "-C", str(tmp), "log", "--oneline"],
+                         capture_output=True, text=True).stdout
+    assert "TPU watchdog" in log
+    shown = subprocess.run(
+        ["git", "-C", str(tmp), "show", "--stat", "--name-only", "HEAD"],
+        capture_output=True, text=True).stdout
+    assert "BENCH_TPU.json" in shown
+
+
+def test_git_commit_survives_missing_artifacts(watchdog):
+    """A timed-out capture step leaves its .out missing; the commit must
+    still record what exists (review finding: the bad pathspec aborted the
+    whole add and silently committed nothing)."""
+    wd, tmp = watchdog
+    (tmp / "exists.txt").write_text("evidence")
+    wd.git_commit(["exists.txt", "never-written.out"], "partial artifacts")
+    shown = subprocess.run(
+        ["git", "-C", str(tmp), "show", "--name-only", "HEAD"],
+        capture_output=True, text=True).stdout
+    assert "exists.txt" in shown
+    assert "partial artifacts" in shown
+
+
+def test_git_commit_logs_when_nothing_exists(watchdog):
+    wd, tmp = watchdog
+    wd.git_commit(["ghost.out"], "nothing real")
+    entries = [json.loads(line)
+               for line in (tmp / "TPU_PROBELOG.jsonl").read_text()
+               .splitlines()]
+    assert any("no artifacts exist" in e["detail"] for e in entries)
