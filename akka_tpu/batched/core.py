@@ -10,11 +10,15 @@ mapping from the reference's hot path (SURVEY.md §3.2):
   registerForExecution CAS + thread pool      the step loop itself (jit)
     (dispatch/Dispatcher.scala:120-143)
   Mailbox.processMailbox dequeue loop         reduce mode: segment reduction;
-    (dispatch/Mailbox.scala:260-277)            slots mode: stable (recipient,
-                                                seq) sort into per-actor
-                                                mailbox slots (ordered,
-                                                per-message — the full
-                                                envelope-mailbox contract)
+    (dispatch/Mailbox.scala:260-277)            slots mode: rank-then-scatter
+                                                ordered delivery — a narrow
+                                                key-only sort ranks messages
+                                                per (recipient, seq), then
+                                                closed-form scatters place
+                                                them into per-actor mailbox
+                                                slots (ordered, per-message —
+                                                the full envelope-mailbox
+                                                contract; ops/segment.py)
   ActorCell.invoke -> receive                 vmapped behavior switch
     (actor/ActorCell.scala:539-555)             (lax.switch over behavior ids)
 
@@ -22,6 +26,12 @@ State is a dict of [capacity, ...] columns (union of all behavior schemas);
 messages are (dst, type, payload, valid) SoA blocks; one `step` delivers every
 in-flight message and runs every live actor's update, entirely on device.
 `run(n)` lax.scans the step so multi-step benches never touch the host.
+
+The ordered-delivery kernels sit behind the `delivery_backend` seam
+(constructor arg, forwarded to ops/segment.py): None/"auto" picks the
+platform cost model, "xla" forces rank-then-scatter, "reference" forces the
+original wide-sort kernels — all bit-identical in results, so the choice is
+purely a performance knob (see docs/DELIVERY_KERNELS.md).
 """
 
 from __future__ import annotations
@@ -75,7 +85,8 @@ class BatchedSystem:
                  need_max: bool = False, topology=None,
                  mailbox_slots: int = 0,
                  native_staging: Optional[bool] = None,
-                 spill_capacity: Optional[int] = None):
+                 spill_capacity: Optional[int] = None,
+                 delivery_backend: Optional[str] = None):
         if not behaviors:
             raise ValueError("at least one behavior required")
         self.capacity = int(capacity)
@@ -86,6 +97,9 @@ class BatchedSystem:
         self.payload_dtype = payload_dtype
         self.device = device
         self.delivery = delivery
+        # ops/segment.py kernel-implementation seam: None/"auto" = platform
+        # cost model, "xla" = rank-then-scatter, "reference" = wide sorts
+        self.delivery_backend = delivery_backend
         self.need_max = need_max
         self.topology = topology  # ops.segment.StaticTopology | None
         self.mailbox_slots = int(mailbox_slots)
@@ -205,7 +219,8 @@ class BatchedSystem:
                               payload_dtype=payload_dtype,
                               slots=self.mailbox_slots, need_max=need_max,
                               topology=topology, delivery=delivery,
-                              spill_cap=self.spill_cap)
+                              spill_cap=self.spill_cap,
+                              delivery_backend=delivery_backend)
 
         # topology tables ride as runtime arguments (pytree): closure
         # constants would be baked into the HLO (multi-MB programs break

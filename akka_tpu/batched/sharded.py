@@ -11,14 +11,17 @@ Routing inside shard_map, per step:
 1. deliver the local inbox (StepCore: segment reduction, or stable-sorted
    per-message mailbox slots — shared with BatchedSystem),
 2. run the vmapped behavior switch (global actor ids),
-3. bucket emitted messages by destination shard (stable sort → rank-in-group
-   → scatter into a [D, C] exchange buffer; overflow drops are counted),
+3. bucket emitted messages by destination shard (rank-in-group over the
+   narrow shard key — rank-then-scatter on cpu/xla backends, reference
+   full-column stable sort otherwise — then scatter into a [D, C] exchange
+   buffer; overflow drops are counted),
 4. `lax.all_to_all` the buffer — each shard receives its [D, C] slice, which
    becomes the next step's inbox (self-addressed chunks deliver locally).
 
-The bucketing sort is stable and each shard's send buffer is drained in slot
-order, so per-sender FIFO survives the exchange (messages from shard s to
-actor a arrive in emission order). Per-pair capacity C defaults to lossless
+Bucketing is arrival-stable (a message's rank counts earlier emissions to
+the same shard) and each shard's send buffer is drained in slot order, so
+per-sender FIFO survives the exchange (messages from shard s to actor a
+arrive in emission order); both strategies fill bit-identical buffers. Per-pair capacity C defaults to lossless
 (all local emissions could target one shard). Static shapes throughout; the
 whole step is one jitted program.
 """
@@ -33,8 +36,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.5: experimental module, check_vma spelt check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
 
+    def shard_map(f, **kw):
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        return _shard_map_legacy(f, **kw)
+
+from ..ops.segment import exchange_uses_ranked, stable_ranks
 from ..parallel.mesh import make_mesh
 from .behavior import BatchedBehavior
 from .step import StepCore
@@ -49,7 +61,8 @@ class ShardedBatchedSystem:
                  payload_dtype=jnp.float32, axis_name: str = "shards",
                  mailbox_slots: int = 0, reroute_strays: bool = False,
                  spill_capacity: Optional[int] = None,
-                 delivery: str = "auto"):
+                 delivery: str = "auto",
+                 delivery_backend: Optional[str] = None):
         self.mesh = mesh if mesh is not None else make_mesh(n_devices, axis_name)
         self.axis = axis_name
         self.n_shards = self.mesh.shape[axis_name]
@@ -85,6 +98,11 @@ class ShardedBatchedSystem:
         # deliverMessage stays a hash + table lookup (:1046).
         self.reroute_strays = bool(reroute_strays)
         self.stray_mode = False
+        # narrow seam for the local-delivery kernel family (segment.py):
+        # None/"auto" = per-platform cost model, "xla" = rank-then-scatter,
+        # "reference" = frozen wide-sort kernels. Results are bit-identical
+        # either way; the knob only moves work off the sort network.
+        self.delivery_backend = delivery_backend
         # lossless default: every local emission could target a single
         # shard; in stray mode, one rebalanced block's worth of forwarded
         # in-flight messages can ride alongside a full emission batch, so
@@ -152,6 +170,7 @@ class ShardedBatchedSystem:
                               slots=self.mailbox_slots,
                               n_global=self.capacity,
                               delivery=delivery,
+                              delivery_backend=delivery_backend,
                               spill_cap=self.spill_cap)
         self._step_fn = None  # built lazily: tables may be set post-init
         self._step_cache: Dict[bool, Any] = {}  # stray-mode -> compiled step
@@ -163,6 +182,8 @@ class ShardedBatchedSystem:
         pair_cap, m_local, axis = self.pair_cap, self.m_local, self.axis
         n_global = self.capacity
         core = self._core
+        platform = self.mesh.devices.flat[0].platform
+        ranked_exchange = exchange_uses_ranked(platform, self.delivery_backend)
 
         def local_step(state, behavior_id, alive, inbox_dst, inbox_type,
                        inbox_payload, inbox_valid, dropped, mail_dropped,
@@ -177,10 +198,16 @@ class ShardedBatchedSystem:
                 dst_offset=base, id_base=base, tables=tables)
 
             # ---- route: bucket by destination shard, exchange over ICI ----
-            # ONE stable keyed sort carries every column through the sort
-            # network (argsort + x[order] gathers serialize on TPU); rank
-            # within the shard group comes from a cummax over head flags
-            # instead of a searchsorted table gather
+            # Two bucketing strategies behind the delivery_backend seam,
+            # producing bit-identical exchange buffers (the slot index for
+            # every in-cap row is the same bijection either way):
+            #  * ranked (cpu/xla): stable_ranks over the narrow shard key
+            #    only — dst/type/payload scatter straight from the original
+            #    domain and never ride a sort network;
+            #  * reference: ONE stable keyed sort carries every column
+            #    through the sort network (argsort + x[order] gathers
+            #    serialize on TPU); rank within the shard group comes from
+            #    a cummax over head flags instead of a searchsorted gather.
             slots_mode = self.mailbox_slots > 0
             out_dst = emits.dst.reshape(-1)                       # [n_local*k]
             out_payload = emits.payload.reshape(-1, p_w)
@@ -203,29 +230,39 @@ class ShardedBatchedSystem:
 
             m = out_dst.shape[0]
             iota = jnp.arange(m, dtype=jnp.int32)
-            fcols = tuple(out_payload[:, i] for i in range(p_w))
-            tcol = (out_type,) if slots_mode else ()  # type rides only if read
-            srt = jax.lax.sort(
-                (dest_shard.astype(jnp.int32), iota, out_dst,
-                 out_valid.astype(jnp.int32)) + tcol + fcols, num_keys=2)
-            ds_sorted, dst_sorted = srt[0], srt[2]
-            ok_sorted = srt[3].astype(jnp.bool_)
-            type_sorted = srt[4] if slots_mode else None
-            pl_sorted = jnp.stack(srt[4 + len(tcol):], axis=1)
-            head = jnp.concatenate([jnp.ones((1,), jnp.bool_),
-                                    ds_sorted[1:] != ds_sorted[:-1]])
-            start = jax.lax.cummax(jnp.where(head, iota, -1))
-            rank = iota - start
-            in_cap = ok_sorted & (rank < pair_cap) & (ds_sorted < n_shards)
-            slot = jnp.where(in_cap, ds_sorted * pair_cap + rank,
-                             n_shards * pair_cap)  # overflow bucket
-            n_dropped = jnp.sum((ok_sorted & ~in_cap).astype(jnp.int32))
+            ds32 = dest_shard.astype(jnp.int32)
+            if ranked_exchange:
+                rank, _ = stable_ranks(ds32, n_shards, platform)
+                in_cap = out_valid & (rank < pair_cap) & (ds32 < n_shards)
+                slot = jnp.where(in_cap, ds32 * pair_cap + rank,
+                                 n_shards * pair_cap)  # overflow bucket
+                n_dropped = jnp.sum((out_valid & ~in_cap).astype(jnp.int32))
+                dst_col, pl_col = out_dst, out_payload
+                type_col = out_type if slots_mode else None
+            else:
+                fcols = tuple(out_payload[:, i] for i in range(p_w))
+                tcol = (out_type,) if slots_mode else ()  # rides only if read
+                srt = jax.lax.sort(
+                    (ds32, iota, out_dst,
+                     out_valid.astype(jnp.int32)) + tcol + fcols, num_keys=2)
+                ds_sorted, dst_col = srt[0], srt[2]
+                ok_sorted = srt[3].astype(jnp.bool_)
+                type_col = srt[4] if slots_mode else None
+                pl_col = jnp.stack(srt[4 + len(tcol):], axis=1)
+                head = jnp.concatenate([jnp.ones((1,), jnp.bool_),
+                                        ds_sorted[1:] != ds_sorted[:-1]])
+                start = jax.lax.cummax(jnp.where(head, iota, -1))
+                rank = iota - start
+                in_cap = ok_sorted & (rank < pair_cap) & (ds_sorted < n_shards)
+                slot = jnp.where(in_cap, ds_sorted * pair_cap + rank,
+                                 n_shards * pair_cap)  # overflow bucket
+                n_dropped = jnp.sum((ok_sorted & ~in_cap).astype(jnp.int32))
 
             buf_dst = jnp.full((n_shards * pair_cap + 1,), -1, jnp.int32)
             buf_pl = jnp.zeros((n_shards * pair_cap + 1, p_w), dtype)
             buf_ok = jnp.zeros((n_shards * pair_cap + 1,), jnp.bool_)
-            buf_dst = buf_dst.at[slot].set(jnp.where(in_cap, dst_sorted, -1))
-            buf_pl = buf_pl.at[slot].set(jnp.where(in_cap[:, None], pl_sorted, 0))
+            buf_dst = buf_dst.at[slot].set(jnp.where(in_cap, dst_col, -1))
+            buf_pl = buf_pl.at[slot].set(jnp.where(in_cap[:, None], pl_col, 0))
             buf_ok = buf_ok.at[slot].set(in_cap)
             buf_dst, buf_pl, buf_ok = buf_dst[:-1], buf_pl[:-1], buf_ok[:-1]
 
@@ -251,7 +288,7 @@ class ShardedBatchedSystem:
                 # reads it — reduce-mode systems skip a whole collective
                 buf_type = jnp.zeros((n_shards * pair_cap + 1,), jnp.int32)
                 buf_type = buf_type.at[slot].set(
-                    jnp.where(in_cap, type_sorted, 0))[:-1]
+                    jnp.where(in_cap, type_col, 0))[:-1]
                 recv_type = jax.lax.all_to_all(
                     buf_type.reshape(n_shards, pair_cap), axis, 0, 0,
                     tiled=False).reshape(-1)

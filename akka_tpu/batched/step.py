@@ -44,7 +44,8 @@ class StepCore:
                  payload_width: int, out_degree: int, payload_dtype,
                  slots: int = 0, need_max: bool = False, topology=None,
                  delivery: str = "auto", n_global: Optional[int] = None,
-                 spill_cap: int = 0):
+                 spill_cap: int = 0,
+                 delivery_backend: Optional[str] = None):
         self.behaviors = list(behaviors)
         self.n_local = int(n_local)
         self.n_global = int(n_global if n_global is not None else n_local)
@@ -55,6 +56,10 @@ class StepCore:
         self.need_max = need_max
         self.topology = topology
         self.delivery = delivery
+        # kernel implementation seam (ops/segment.py): None/"auto" = the
+        # platform cost model, "xla" = rank-then-scatter, "reference" =
+        # the original wide-sort kernels
+        self.delivery_backend = delivery_backend
         # spill region size (slots mode): overflow + suspended-row mail is
         # retained there instead of dropped (unbounded-mailbox semantics)
         self.spill_cap = int(spill_cap)
@@ -126,7 +131,8 @@ class StepCore:
                                  n, self.slots, self.need_max,
                                  spill_cap=self.spill_cap,
                                  slots_kind=slots_kind_row,
-                                 suspended=suspended)
+                                 suspended=suspended,
+                                 backend=self.delivery_backend)
         if self.topology is not None:
             nk = self.n_local * self.out_degree
             d = deliver_static(self.topology, topo_arrays,
@@ -155,7 +161,7 @@ class StepCore:
                                  (tail_d, tail_p, tail_v))
             return d
         return deliver(dst, inbox_payload, inbox_valid, n, self.need_max,
-                       mode=self.delivery)
+                       mode=self.delivery, backend=self.delivery_backend)
 
     # -------------------------------------------------------------- update
     def update(self, state, behavior_id, alive, delivered, step_count,

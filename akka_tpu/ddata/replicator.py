@@ -587,6 +587,14 @@ class Replicator(Actor):
                 merged = self._cleanup_pruned(key, cur).merge(incoming)
         if merged != cur:
             self._set_data(key, merged)
+            if merged == DELETED:
+                # remote delete (a _Write/_Gossip carried the tombstone):
+                # drop the key's delta bookkeeping exactly as the local
+                # Delete path does — dead keys must not pin cursors, and
+                # a pending accumulated delta for them is never sent
+                self.deltas.pop(key, None)
+                self.delta_seq.pop(key, None)
+                self._drop_delta_cursors(key=key)
 
     def _cleanup_pruned(self, key: str, value: Any) -> Any:
         """Drop tombstoned nodes' residual entries from stale incoming state
@@ -888,6 +896,8 @@ class Replicator(Actor):
                     self._set_data(k, cleaned, notify=False)
         for k, v in msg.entries.items():
             self._merge_in(k, v)
+            if self.data.get(k) == DELETED:
+                continue  # dead key: no cursor resync (see _merge_in prune)
             if k in msg.delta_seq and msg.origin_uid:
                 # the full state covers every op of the sender up to this
                 # seq: resync the delta cursor and resume op-based deltas

@@ -5,6 +5,27 @@ TPU-native replacement for the reference's MPSC mailbox queues
 are SoA columns (dst, payload, valid) and "enqueue + dequeue" becomes one
 segment reduction per step — sums/maxes/counts land in per-actor slots.
 
+Two kernel families implement the ordered paths (see
+docs/DELIVERY_KERNELS.md for the measured crossover table):
+
+- "ranked" (rank-then-scatter, the default XLA backend): ONE sort over a
+  narrow int32 key operand (on CPU a single packed (key, arrival-block)
+  operand — see `stable_ranks`) computes per-recipient ranks/offsets;
+  every slot index, spill position and aggregation offset is then
+  closed-form, and payload rows move with one scatter/gather — payload
+  columns never ride the sort network.
+- "wide" (the reference backend, kept for A/B and for TPU where its
+  numbers were actually measured): every payload column rides a
+  multi-operand sort (measured ~70x the narrow sort at 1M rows on CPU).
+
+Kernel implementation choice is behind the `delivery_backend` seam
+(set_delivery_backend / the `backend=` argument) so a Pallas backend can
+drop in later without touching callers; `mode="auto"` routes through the
+cost model in `choose_reduce_kernel`. Both families produce bit-identical
+`Delivery`/`SlotDelivery` results (up to the sign of floating-point zero
+— the wide kernels' marker rows interleave +0.0 additions), enforced by
+tests/test_delivery_parity.py.
+
 All functions are jit-safe, static-shape, and XLA-fusable. The drop bucket
 (index n_actors) absorbs invalid/out-of-range messages so no dynamic filtering
 is needed.
@@ -39,46 +60,287 @@ class Delivery(NamedTuple):
     count: jax.Array   # [N] int32
 
 
+# ---------------------------------------------------------------------------
+# delivery_backend seam
+#
+# A backend names the IMPLEMENTATION of the ordered kernels (merge/sort/
+# slots); the mode names the SEMANTIC variant callers ask for. Keeping the
+# two orthogonal is what lets a Pallas backend drop in later without
+# touching callers (VERDICT next-round #3).
+#
+#   "auto"      — cost-model choice per platform (ranked on CPU, wide on
+#                 TPU until the attribution bench runs on-chip)
+#   "xla"       — the rank-then-scatter kernels (narrow key sort + one
+#                 payload gather/scatter)
+#   "reference" — the original wide multi-operand-sort kernels, kept
+#                 bit-for-bit for parity tests and on-chip A/B
+# ---------------------------------------------------------------------------
+
+DELIVERY_BACKENDS = ("auto", "xla", "reference")
+_delivery_backend = "auto"
+
+
+def set_delivery_backend(name: str) -> str:
+    """Set the process-default delivery backend; returns the previous one.
+    Per-call `backend=` arguments override this."""
+    global _delivery_backend
+    if name not in DELIVERY_BACKENDS:
+        raise ValueError(f"unknown delivery backend {name!r}; "
+                         f"expected one of {DELIVERY_BACKENDS}")
+    prev = _delivery_backend
+    _delivery_backend = name
+    return prev
+
+
+def get_delivery_backend() -> str:
+    return _delivery_backend
+
+
+def _backend_impl(backend: str | None, platform: str) -> str:
+    """Resolve a backend name to a kernel family: 'ranked' or 'wide'."""
+    backend = backend or _delivery_backend
+    if backend == "reference":
+        return "wide"
+    if backend == "xla":
+        return "ranked"
+    # auto: ranked is measured faster on CPU (docs/DELIVERY_KERNELS.md
+    # crossover table); the wide kernels' TPU numbers are the only ones
+    # actually measured on-chip (r4), so TPU keeps them until
+    # delivery_attribution runs in a TPU window.
+    return "ranked" if platform == "cpu" else "wide"
+
+
+# Below this message count the reduce kernels are N-shaped (markers /
+# boundary reads dominate) while scatter is M-shaped; measured r4.
+SCATTER_MAX_M = 1024
+
+
+def choose_reduce_kernel(m: int, n_actors: int, p: int,
+                         platform: str = "cpu") -> str:
+    """Cost model for mode="auto": pick the reduce-delivery mode from
+    (M, N, P, platform). Crossover points are measured by the bench
+    artifact (bench.py modes config + delivery_attribution), recorded in
+    docs/DELIVERY_KERNELS.md:
+
+    - cpu: XLA scatter-add beats every sort at every measured shape (64k
+      actors, P=4, bench modes config: scatter 7.6 ms/step vs ranked
+      merge 11.2 vs wide merge ~123). Always scatter.
+    - M <= SCATTER_MAX_M: scatter — a few host rows into a large actor
+      space would pay an N-shaped sort for an M-shaped problem.
+    - tpu/gpu: merge (the wide merge kernel is the one with on-chip
+      measurements: sorts vectorize, 1M-row gathers and unsorted scatters
+      run 10-40x slower). The ranked kernel's single [M, P] gather is
+      unmeasured on-chip; the per-phase attribution exists so the next
+      TPU window can move this crossover from assertion to measurement.
+    """
+    del n_actors, p  # present in the signature for future crossovers
+    if platform == "cpu" or m <= SCATTER_MAX_M:
+        return "scatter"
+    return "merge"
+
+
 def deliver(dst: jax.Array, payload: jax.Array, valid: jax.Array,
             n_actors: int, need_max: bool = False,
-            mode: str = "auto") -> Delivery:
+            mode: str = "auto", backend: str | None = None) -> Delivery:
     """Reduce messages into per-actor inbox slots.
 
     dst: [M] int32 recipient ids; payload: [M, P]; valid: [M] bool.
     Invalid or out-of-range messages fall into a drop bucket.
 
-    Modes (profiled on TPU v5e at M=N=1M):
-    - "merge":   ONE combined lax.sort of messages + per-actor boundary
-      markers, cumsum, then a second narrow sort compacts the markers back
-      to actor order — sums/counts are elementwise diffs. Fully gather- and
-      scatter-free: TPU sorts are fast; 1M-row gathers and unsorted
-      scatters are 10-40x slower (searchsorted's default binary search is
-      ~20 sequential gathers).
-    - "scatter": XLA scatter-add (segment_sum). Fine for SMALL M (a few
-      host rows into a large actor space — the merge sort would be
-      N-shaped); pathological for large unsorted M on TPU.
-    - "sort":    sort + searchsorted + cumsum-gathers (the original
-      reference implementation; CPU-friendly, gather-heavy on TPU).
-    - "auto":    platform-aware (decided at trace time, so it is free at
-      runtime): scatter for tiny M; scatter on CPU backends, where XLA's
-      scatter-add lowers to a serial loop that still beats two full
-      multi-operand sorts by ~70x (bench.py modes, r4); merge on TPU,
-      where sorts vectorize and unsorted scatters serialize.
+    Modes:
+    - "scatter": XLA scatter-add (segment_sum). Wins for small M and on
+      CPU, where scatter-add lowers to a serial O(M) loop.
+    - "merge" / "sort": the ordered sort-based kernels. Which
+      IMPLEMENTATION runs is the backend's choice: under the default
+      "xla" (rank-then-scatter) backend both lower to `_deliver_ranked`
+      — a narrow (key, arrival) sort plus one payload gather — because
+      once payload stops riding the sort network the historical
+      merge/sort distinction collapses. Under backend="reference" the
+      original wide kernels run (`_deliver_merge_wide`,
+      `_deliver_sorted_wide`).
+    - "auto": `choose_reduce_kernel` cost model over (M, N, P, platform),
+      decided at trace time so it is free at runtime.
+
+    All choices return bit-identical results (up to the sign of float
+    zero); tests/test_delivery_parity.py enforces it.
     """
     if mode == "auto":
-        if dst.shape[0] <= 1024 or _resolve_platform(dst) == "cpu":
-            mode = "scatter"
+        mode = choose_reduce_kernel(dst.shape[0], n_actors,
+                                    payload.shape[1],
+                                    _resolve_platform(dst))
+    if mode == "scatter":
+        return _deliver_scatter(dst, payload, valid, n_actors, need_max)
+    impl = _backend_impl(backend, _resolve_platform(dst))
+    if impl == "wide":
+        if mode == "merge":
+            return _deliver_merge_wide(dst, payload, valid, n_actors,
+                                       need_max)
+        return _deliver_sorted_wide(dst, payload, valid, n_actors, need_max)
+    return _deliver_ranked(dst, payload, valid, n_actors, need_max,
+                           style=mode)
+
+
+# Within-block triangle size for the packed-sort rank strategy: the
+# [M/B, B, B] equality triangle costs M*B vectorized ops, the int32
+# packing needs (n_actors + 2) * ceil(M/B) < 2^31. B=32 keeps both sides
+# comfortable up to ~1M actors at the bench's CPU auto scale.
+_RANK_BLOCK = 32
+
+
+def stable_ranks(key: jax.Array, n_keys: int,
+                 platform: str | None = None) -> Tuple[jax.Array, jax.Array]:
+    """The 'rank' phase of rank-then-scatter: for each row, the number of
+    EARLIER rows with the same key (its stable arrival rank within the
+    recipient), plus per-key counts. Returns (rank [M] int32,
+    counts [n_keys + 1] int32); keys must lie in [0, n_keys].
+
+    Everything downstream — slot indices, spill positions, the inverse
+    sort permutation inv = offsets[key] + rank — is closed-form from
+    these two arrays, so no payload column ever rides a sort network.
+
+    Two strategies, chosen at trace time:
+
+    - packed (CPU default): pack (key, block-of-B arrival index) into ONE
+      int32 and single-operand lax.sort it — XLA CPU's single-operand
+      sort measured 5.3x faster than the generic-comparator two-operand
+      (key, iota) sort. Cross-block ranks come back via vectorized binary
+      search on the sorted packs; within-block ranks via a [B, B]
+      equality triangle. Exact integers throughout.
+    - narrow sort (TPU/GPU, or shapes whose packing would overflow
+      int32): the two-operand (key, iota) sort + head-flag/cummax ranks
+      (sorts vectorize on accelerators; the searchsorted binary search
+      would serialize into ~20 dependent gathers).
+    """
+    m = key.shape[0]
+    nb = -(-m // _RANK_BLOCK)
+    if platform is None:
+        platform = _resolve_platform(key)
+    if platform == "cpu" and (n_keys + 2) * nb < 2 ** 31:
+        kp, packed = _pack_keys(key, n_keys)
+        psorted = jax.lax.sort(packed)
+        rank, counts = _ranks_from_packed(psorted, packed, kp, n_keys)
+        return rank[:m], counts
+    iota = jnp.arange(m, dtype=jnp.int32)
+    skey, sidx = jax.lax.sort((key, iota), num_keys=1)
+    head = jnp.concatenate([jnp.ones((1,), jnp.bool_), skey[1:] != skey[:-1]])
+    start = jax.lax.cummax(jnp.where(head, iota, -1))
+    rank = jnp.zeros((m,), jnp.int32).at[sidx].set(iota - start)
+    bounds = jnp.searchsorted(
+        skey, jnp.arange(n_keys + 2, dtype=jnp.int32)).astype(jnp.int32)
+    return rank, bounds[1:] - bounds[:-1]
+
+
+def _pack_keys(key: jax.Array, n_keys: int):
+    """Pack (key, arrival-block) into a single int32 sort operand; rows
+    past M pad with key n_keys + 1 so they sort last and never perturb
+    counts. Returns (padded keys [nb*B], packed operand [nb*B])."""
+    m = key.shape[0]
+    b = _RANK_BLOCK
+    nb = -(-m // b)
+    pad = nb * b - m
+    kp = (key if pad == 0 else
+          jnp.concatenate([key, jnp.full((pad,), n_keys + 1, jnp.int32)]))
+    blk = jnp.arange(nb * b, dtype=jnp.int32) // b
+    return kp, kp * nb + blk
+
+
+def _ranks_from_packed(psorted, packed, kp, n_keys: int):
+    """The rank phase proper: cross-block same-key counts via vectorized
+    binary search on the sorted packs, within-block counts via a [B, B]
+    equality triangle. Returns (rank [nb*B], counts [n_keys + 1])."""
+    b = _RANK_BLOCK
+    nb = packed.shape[0] // b
+    kb = jnp.searchsorted(
+        psorted,
+        jnp.arange(n_keys + 2, dtype=jnp.int32) * nb).astype(jnp.int32)
+    counts = kb[1:] - kb[:-1]                              # [n_keys + 1]
+    before = (jnp.searchsorted(psorted, packed).astype(jnp.int32)
+              - kb[kp])                # same-key rows in earlier blocks
+    k2 = kp.reshape(nb, b)
+    tri = jnp.tril(jnp.ones((b, b), jnp.bool_), k=-1)      # tri[i, j] = j < i
+    within = jnp.sum((k2[:, :, None] == k2[:, None, :]) & tri[None],
+                     axis=2, dtype=jnp.int32)
+    return before + within.reshape(-1), counts
+
+
+def _merged_layout_sums(inv, key, incl, masked, n_actors: int) -> jax.Array:
+    """Per-segment sums with the EXACT float association of the wide merge
+    kernel: messages and the n+1 zero marker rows share one cumsum of
+    length M + N + 1, and XLA's scan-tree association depends on that
+    length. The interleaved layout is closed-form — row i lands at
+    inv[i] + key[i] (key[i] markers precede it), marker k at
+    k + incl[k] — so ONE narrow int32 scatter of row indices rebuilds it
+    (the [., P] payload rows follow by gather, ~60x cheaper than
+    scattering them) without any wide sort."""
+    m, p = masked.shape
+    n1 = n_actors + 1
+    g = jnp.full((m + n1,), -1, jnp.int32).at[inv + key].set(
+        jnp.arange(m, dtype=jnp.int32))
+    merged = jnp.where((g >= 0)[:, None], masked[jnp.maximum(g, 0)], 0)
+    csum = jnp.cumsum(merged, axis=0)
+    mk = csum[jnp.arange(n1, dtype=jnp.int32) + incl]
+    return jnp.concatenate([mk[:1], mk[1:] - mk[:-1]],
+                           axis=0)[:n_actors].astype(masked.dtype)
+
+
+def _deliver_ranked(dst, payload, valid, n_actors: int, need_max: bool,
+                    style: str = "merge") -> Delivery:
+    """Rank-then-scatter segment reduction.
+
+    Phases (the names match bench.py's attribution breakdown):
+
+    - key-sort + rank: `stable_ranks` — only narrow int32 keys are ever
+      sorted.
+    - place: ONE [M, P] scatter at the closed-form inverse permutation
+      lines payload rows up in (recipient, arrival) order.
+    - reduce: per-column cumsum + boundary reads. The partial-sum
+      sequence replicates the wide kernel of the same `style`
+      bit-for-bit ("merge" interleaves the n+1 zero marker rows into the
+      cumsum, "sort" runs it over the M message rows), because XLA's
+      scan-tree association depends on layout and length.
+
+    `style` also preserves each wide kernel's empty-segment max
+    convention ("merge" zeroes max <= -inf sentinels, "sort" zeroes
+    count == 0 segments) so parity holds against either reference.
+    """
+    m, p = payload.shape
+    ok = valid & (dst >= 0) & (dst < n_actors)
+    key = jnp.where(ok, dst, n_actors).astype(jnp.int32)
+    rank, counts_full = stable_ranks(key, n_actors, _resolve_platform(dst))
+    incl = jnp.cumsum(counts_full)                          # [n+1]
+    excl = jnp.concatenate([jnp.zeros((1,), jnp.int32), incl[:-1]])
+    inv = excl[key] + rank
+    counts = counts_full[:n_actors]
+    masked = jnp.where(ok[:, None], payload, 0)
+    if style == "merge":
+        sums = _merged_layout_sums(inv, key, incl, masked, n_actors)
+    else:
+        # inv is a bijection on [0, M), so inverting it is one narrow
+        # int32 scatter; the payload rows follow by gather
+        g = jnp.zeros((m,), jnp.int32).at[inv].set(
+            jnp.arange(m, dtype=jnp.int32))
+        csum = jnp.concatenate([jnp.zeros((1, p), payload.dtype),
+                                jnp.cumsum(masked[g], axis=0)], axis=0)
+        sums = (csum[incl[:n_actors]]
+                - csum[excl[:n_actors]]).astype(payload.dtype)
+    if need_max:
+        neg_inf = _neg_inf(payload.dtype)
+        maxs = jax.ops.segment_max(jnp.where(ok[:, None], payload, neg_inf),
+                                   key, num_segments=n_actors + 1)[:n_actors]
+        if style == "merge":
+            maxs = jnp.where(maxs <= neg_inf, jnp.zeros_like(maxs), maxs)
         else:
-            mode = "merge"
-    if mode == "merge":
-        return _deliver_merge(dst, payload, valid, n_actors, need_max)
-    if mode == "sort":
-        return _deliver_sorted(dst, payload, valid, n_actors, need_max)
-    return _deliver_scatter(dst, payload, valid, n_actors, need_max)
+            maxs = jnp.where((counts > 0)[:, None], maxs, 0)
+        maxs = maxs.astype(payload.dtype)
+    else:
+        maxs = jnp.zeros((n_actors, p), payload.dtype)
+    return Delivery(sum=sums, max=maxs, count=counts)
 
 
-def _deliver_merge(dst, payload, valid, n_actors: int, need_max: bool) -> Delivery:
-    """Gather/scatter-free segment reduction via a merged marker sort.
+def _deliver_merge_wide(dst, payload, valid, n_actors: int,
+                        need_max: bool) -> Delivery:
+    """Gather/scatter-free segment reduction via a merged marker sort
+    (the "reference" backend; payload columns ride both sorts).
 
     Sort #1: messages and n+1 boundary markers together, on the packed key
     ``key*2 + tag`` (tag: 0 = message, 1 = marker) so marker i lands
@@ -173,8 +435,10 @@ def _deliver_scatter(dst, payload, valid, n_actors: int, need_max: bool) -> Deli
     return Delivery(sum=sums[:n_actors], max=maxs, count=counts)
 
 
-def _deliver_sorted(dst, payload, valid, n_actors: int, need_max: bool) -> Delivery:
-    """Sort-by-recipient + cumsum-difference segment reduction (no scatter)."""
+def _deliver_sorted_wide(dst, payload, valid, n_actors: int,
+                         need_max: bool) -> Delivery:
+    """Sort-by-recipient + cumsum-difference segment reduction, with every
+    payload column riding the sort ("reference" backend)."""
     p = payload.shape[1]
     ok = valid & (dst >= 0) & (dst < n_actors)
     key = jnp.where(ok, dst, n_actors).astype(jnp.int32)
@@ -234,7 +498,8 @@ class SlotDelivery(NamedTuple):
 def deliver_slots(dst: jax.Array, mtype: jax.Array, payload: jax.Array,
                   valid: jax.Array, n_actors: int, slots: int,
                   need_max: bool = False, spill_cap: int = 0,
-                  slots_kind=None, suspended=None) -> SlotDelivery:
+                  slots_kind=None, suspended=None,
+                  backend: str | None = None) -> SlotDelivery:
     """Ordered per-message delivery into per-actor mailbox slots.
 
     The TPU-native form of the reference's discrete-envelope mailbox
@@ -261,7 +526,152 @@ def deliver_slots(dst: jax.Array, mtype: jax.Array, payload: jax.Array,
     the FRONT of the next step's inbox, so redelivered mail sorts before any
     fresh emission and per-sender FIFO is preserved across spill generations.
     Only spill-region overflow is a real (counted) drop.
+
+    `backend` picks the kernel implementation (see module docstring):
+    rank-then-scatter ("xla"), the original wide-sort kernel
+    ("reference"), or the platform cost model (None/"auto"). Results are
+    bit-identical either way.
     """
+    impl = _backend_impl(backend, _resolve_platform(dst))
+    fn = _deliver_slots_ranked if impl == "ranked" else _deliver_slots_wide
+    return fn(dst, mtype, payload, valid, n_actors, slots, need_max,
+              spill_cap, slots_kind, suspended)
+
+
+def _deliver_slots_ranked(dst, mtype, payload, valid, n_actors: int,
+                          slots: int, need_max: bool, spill_cap: int,
+                          slots_kind, suspended) -> SlotDelivery:
+    """Rank-then-scatter slots delivery, entirely in the ORIGINAL row
+    order: `stable_ranks` sorts narrow int32 keys only, and every slot
+    index, spill position and aggregation offset is then closed-form
+    from (rank, counts). One int32 scatter inverts the sort permutation;
+    mailbox and spill rows are pure gathers off it, and the consumed
+    aggregation pays one more narrow scatter — payload columns never
+    ride a sort network. Phases mirror bench.py's attribution breakdown
+    (key-sort / rank / place / reduce)."""
+    m, p = payload.shape
+    ok = valid & (dst >= 0) & (dst < n_actors)
+    key = jnp.where(ok, dst, n_actors).astype(jnp.int32)
+    cdst = jnp.clip(dst, 0, n_actors - 1)
+
+    # --- key-sort + rank: arrival rank within recipient, per-key counts
+    rank, counts_full = stable_ranks(key, n_actors, _resolve_platform(dst))
+    counts = counts_full[:n_actors]
+
+    incl = jnp.cumsum(counts_full)                          # [n+1]
+    excl = jnp.concatenate([jnp.zeros((1,), jnp.int32), incl[:-1]])
+    inv = excl[key] + rank
+
+    if spill_cap > 0:
+        susp_n = (suspended if suspended is not None
+                  else jnp.zeros((n_actors,), jnp.bool_))
+        kind_n = (slots_kind if slots_kind is not None
+                  else jnp.ones((n_actors,), jnp.bool_))
+        kind_m = (slots_kind[cdst] if slots_kind is not None
+                  else jnp.ones((m,), jnp.bool_))
+        susp_m = (suspended[cdst] if suspended is not None
+                  else jnp.zeros((m,), jnp.bool_))
+        spill = ok & (susp_m | (kind_m & (rank >= slots)))
+        consumed = ok & ~spill
+    else:
+        spill = jnp.zeros((m,), jnp.bool_)
+        consumed = ok
+
+    # --- place: ONE narrow int32 scatter inverts the sort permutation
+    # (inv is a bijection on [0, M)); every mailbox row and spill row is
+    # then a pure gather at a closed-form sorted position, so payload
+    # columns are touched exactly once
+    s2o = jnp.zeros((m,), jnp.int32).at[inv].set(
+        jnp.arange(m, dtype=jnp.int32), unique_indices=True,
+        mode="promise_in_bounds")
+    kk = jnp.arange(n_actors * slots, dtype=jnp.int32) // slots
+    jj = jnp.arange(n_actors * slots, dtype=jnp.int32) % slots
+    buf_v = jj < counts[kk]
+    if spill_cap > 0:
+        buf_v &= ~susp_n[kk]
+    row = s2o[jnp.minimum(excl[kk] + jj, m - 1)]
+    buf_t = jnp.where(buf_v, mtype[row], 0)
+    buf_p = jnp.where(buf_v[:, None], payload[row], 0)
+
+    # spill compaction: the wide kernel assigns spill positions by a
+    # cumsum over the (recipient, seq)-sorted spill flags; that same
+    # position is closed-form here — per-key spill counts (suspended
+    # rows spill everything, slots-kind rows spill past `slots`) prefix-
+    # summed across keys invert back to (key, within-rank) per spill
+    # slot with one [spill_cap] binary search, no second scatter
+    if spill_cap > 0:
+        spc = jnp.where(susp_n, counts,
+                        jnp.where(kind_n,
+                                  jnp.maximum(counts - slots, 0), 0))
+        sp_excl = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                   jnp.cumsum(spc)])          # [n+1]
+        ss = jnp.arange(spill_cap, dtype=jnp.int32)
+        k_s = (jnp.searchsorted(sp_excl, ss, side="right").astype(jnp.int32)
+               - 1)
+        k_c = jnp.minimum(k_s, n_actors - 1)
+        r_s = (ss - sp_excl[k_c]
+               + jnp.where(susp_n[k_c], 0, slots))
+        srow = s2o[jnp.minimum(excl[k_c] + r_s, m - 1)]
+        sp_v = ss < jnp.minimum(sp_excl[n_actors], spill_cap)
+        sp_dst = jnp.where(sp_v, k_c, -1)
+        sp_type = jnp.where(sp_v, mtype[srow], 0)
+        sp_pl = jnp.where(sp_v[:, None], payload[srow], 0)
+        dropped = jnp.maximum(sp_excl[n_actors] - spill_cap, 0)
+        spill_out = (sp_dst, sp_type, sp_pl, sp_v)
+    else:
+        spc = None
+        in_cap = ok & (rank < slots)
+        dropped = jnp.sum((ok & ~in_cap).astype(jnp.int32))
+        spill_out = (jnp.full((0,), -1, jnp.int32),
+                     jnp.zeros((0,), jnp.int32),
+                     jnp.zeros((0, p), payload.dtype),
+                     jnp.zeros((0,), jnp.bool_))
+
+    # --- reduce: exact consumed aggregation. _merged_layout_sums
+    # reproduces the wide kernel's marker-interleaved cumsum bit-for-bit
+    # (one scatter instead of two wide sorts); consumed counts are
+    # integer-exact differences
+    sums = _merged_layout_sums(inv, key,
+                               incl, jnp.where(consumed[:, None], payload, 0),
+                               n_actors)
+    a_counts = counts - spc if spill_cap > 0 else counts
+    if need_max:
+        # non-consumed live rows contribute 0 exactly like the wide
+        # kernel's masked columns; the -inf sentinel only marks segments
+        # with no rows at all
+        neg_inf = _neg_inf(payload.dtype)
+        vals = jnp.where(consumed[:, None], payload,
+                         jnp.zeros((), payload.dtype))
+        vals = jnp.where(ok[:, None], vals, neg_inf)
+        maxs = jax.ops.segment_max(vals, key,
+                                   num_segments=n_actors + 1)[:n_actors]
+        maxs = jnp.where(maxs <= neg_inf, jnp.zeros_like(maxs),
+                         maxs).astype(payload.dtype)
+    else:
+        maxs = jnp.zeros((n_actors, p), payload.dtype)
+
+    return SlotDelivery(
+        types=buf_t.reshape(n_actors, slots),
+        payload=buf_p.reshape(n_actors, slots, p),
+        valid=buf_v.reshape(n_actors, slots),
+        count=a_counts,
+        sum=sums,
+        max=maxs,
+        dropped=dropped,
+        spill_dst=spill_out[0],
+        spill_type=spill_out[1],
+        spill_payload=spill_out[2],
+        spill_valid=spill_out[3],
+    )
+
+
+def _deliver_slots_wide(dst, mtype, payload, valid, n_actors: int,
+                        slots: int, need_max: bool, spill_cap: int,
+                        slots_kind, suspended) -> SlotDelivery:
+    """The original wide-sort slots kernel ("reference" backend): every
+    payload column rides the (P+4)-operand sort, and the aggregation pays
+    two more wide marker sorts. Kept bit-for-bit for parity testing and
+    for TPU, where its numbers were actually measured."""
     m, p = payload.shape
     ok = valid & (dst >= 0) & (dst < n_actors)
     key = jnp.where(ok, dst, n_actors).astype(jnp.int32)
@@ -554,6 +964,115 @@ def deliver_static(topo: StaticTopology, arrays: tuple, payload: jax.Array,
 def _neg_inf(dtype):
     return jnp.asarray(-jnp.inf if jnp.issubdtype(dtype, jnp.floating)
                        else jnp.iinfo(dtype).min, dtype)
+
+
+def exchange_uses_ranked(platform: str, backend: str | None = None) -> bool:
+    """Kernel choice for sharded.py's exchange bucketing (rank-in-group +
+    scatter into the [D, C] all_to_all buffer): same seam and the same
+    measured tradeoff as the slots kernel — ranked on CPU, wide on TPU
+    until on-chip attribution lands."""
+    return _backend_impl(backend, platform) == "ranked"
+
+
+def delivery_attribution(m: int, n_actors: int, p: int = 4, slots: int = 2,
+                         repeats: int = 3, seed: int = 0) -> dict:
+    """Measure the per-phase cost of the rank-then-scatter slots kernel at
+    one shape on the current default backend; the numbers feed bench.py's
+    modes config and docs/DELIVERY_KERNELS.md so kernel choices are
+    attributed, not asserted.
+
+    Phases (exactly the blocks of `_deliver_slots_ranked`):
+      key_sort_ms — the ONE single-operand lax.sort over packed
+                    (key, arrival-block) int32 keys
+      rank_ms     — binary-search cross-block offsets + within-block
+                    equality triangle + per-key counts
+      place_ms    — one inverse-permutation scatter + mailbox gathers
+                    at closed-form slot positions
+      reduce_ms   — marker-interleaved layout scatter + cumsum +
+                    boundary reads (the bit-exact consumed aggregation)
+    plus wide_sort_ms, the reference kernel's (P+4)-operand sort at the
+    same shape — the single number that motivates the whole scheme.
+
+    Each phase is jitted standalone and timed best-of-`repeats` with
+    block_until_ready; dict values are milliseconds.
+    """
+    import time as _time
+
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    dst = jnp.asarray(rng.integers(0, n_actors, size=m), jnp.int32)
+    mtype = jnp.asarray(rng.integers(0, 4, size=m), jnp.int32)
+    payload = jnp.asarray(rng.standard_normal((m, p)), jnp.float32)
+    key = dst
+    iota = jnp.arange(m, dtype=jnp.int32)
+
+    def key_sort(key):
+        _, packed = _pack_keys(key, n_actors)
+        return jax.lax.sort(packed)
+
+    def rank_phase(psorted, key):
+        kp, packed = _pack_keys(key, n_actors)
+        rank, counts = _ranks_from_packed(psorted, packed, kp, n_actors)
+        return rank[:m], counts
+
+    def place_phase(rank, counts_full, key, mtype, payload):
+        incl = jnp.cumsum(counts_full)
+        excl = jnp.concatenate([jnp.zeros((1,), jnp.int32), incl[:-1]])
+        inv = excl[key] + rank
+        s2o = jnp.zeros((m,), jnp.int32).at[inv].set(
+            jnp.arange(m, dtype=jnp.int32), unique_indices=True,
+            mode="promise_in_bounds")
+        kk = jnp.arange(n_actors * slots, dtype=jnp.int32) // slots
+        jj = jnp.arange(n_actors * slots, dtype=jnp.int32) % slots
+        buf_v = jj < counts_full[kk]
+        row = s2o[jnp.minimum(excl[kk] + jj, m - 1)]
+        return (jnp.where(buf_v, mtype[row], 0),
+                jnp.where(buf_v[:, None], payload[row], 0), buf_v)
+
+    def reduce_phase(rank, counts_full, key, payload):
+        incl = jnp.cumsum(counts_full)
+        excl = jnp.concatenate([jnp.zeros((1,), jnp.int32), incl[:-1]])
+        inv = excl[key] + rank
+        consumed = key < n_actors
+        sums = _merged_layout_sums(
+            inv, key, incl, jnp.where(consumed[:, None], payload, 0),
+            n_actors)
+        return sums, counts_full[:n_actors]
+
+    def wide_sort(key, iota, mtype, payload):
+        fcols = tuple(payload[:, i] for i in range(payload.shape[1]))
+        flags = jnp.zeros_like(key)
+        return jax.lax.sort((key, iota, mtype, flags) + fcols, num_keys=2)
+
+    def _best_ms(fn, *args):
+        jfn = jax.jit(fn)
+        jax.block_until_ready(jfn(*args))  # compile outside the clock
+        best = float("inf")
+        for _ in range(max(repeats, 1)):
+            t0 = _time.perf_counter()
+            jax.block_until_ready(jfn(*args))
+            best = min(best, _time.perf_counter() - t0)
+        return best * 1e3
+
+    psorted = jax.jit(key_sort)(key)
+    rank, counts_full = jax.jit(rank_phase)(psorted, key)
+    out = {
+        "platform": jax.default_backend(),
+        "m": int(m), "n": int(n_actors), "p": int(p), "slots": int(slots),
+        "key_sort_ms": _best_ms(key_sort, key),
+        "rank_ms": _best_ms(rank_phase, psorted, key),
+        "place_ms": _best_ms(place_phase, rank, counts_full, key, mtype,
+                             payload),
+        "reduce_ms": _best_ms(reduce_phase, rank, counts_full, key, payload),
+        "wide_sort_ms": _best_ms(wide_sort, key, iota, mtype, payload),
+    }
+    out["total_ms"] = round(out["key_sort_ms"] + out["rank_ms"]
+                            + out["place_ms"] + out["reduce_ms"], 4)
+    for k in ("key_sort_ms", "rank_ms", "place_ms", "reduce_ms",
+              "wide_sort_ms"):
+        out[k] = round(out[k], 4)
+    return out
 
 
 def route_one_hop(dst: jax.Array, perm_table: jax.Array) -> jax.Array:

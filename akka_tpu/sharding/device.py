@@ -147,6 +147,10 @@ class DeviceShardRegion:
         self._promise_block = free.pop()
         self._free_blocks: List[int] = free
         self._promise_free: List[int] = list(range(self.eps))
+        # slots whose ask timed out with the reply still in flight: parked
+        # here until the row's `__promise_replied` latch is observed True
+        # (the late reply landed), then returned to the free list
+        self._promise_retired: List[int] = []
         self._promise_spawned = False
         self._lock = threading.Lock()
         self._ask_lock = threading.Lock()  # asks serialize (stepping API)
@@ -216,10 +220,13 @@ class DeviceShardRegion:
         to `max_extra_steps` more before declaring the ask unanswered.
         Asks SERIALIZE (this is a stepping API driving the shared runtime);
         a timed-out ask's slot is retired, not reused — a late reply
-        landing in a recycled row would otherwise answer the wrong ask."""
+        landing in a recycled row would otherwise answer the wrong ask.
+        Retirement is not permanent: once the late reply is observed to
+        have landed (`__promise_replied` True) the slot is reclaimed."""
         from ..batched.bridge import max_exact_row_id
         with self._ask_lock:
             self._ensure_promise_rows()
+            self._reclaim_promise_slots()
             sys = self.system
             with self._lock:
                 if not self._promise_free:
@@ -257,10 +264,35 @@ class DeviceShardRegion:
                         "__promise_reply",
                         np.asarray([prow], np.int32))[0])
             # timed out: RETIRE the slot (late replies must land in a row
-            # no future ask will read — the bridge's promise-zombie rule)
+            # no future ask will read — the bridge's promise-zombie rule).
+            # It is parked, not leaked: _reclaim_promise_slots returns it
+            # once the latch shows the straggler reply arrived.
+            with self._lock:
+                self._promise_retired.append(slot)
             raise TimeoutError(
                 f"ask to shard {shard} index {index} unanswered after "
                 f"{steps + max_extra_steps} steps")
+
+    def _reclaim_promise_slots(self) -> int:
+        """Return retired ask slots whose `__promise_replied` latch is now
+        True to the free list. A True latch means the late reply HAS landed,
+        so no in-flight message can target the row any more and recycling
+        cannot mis-deliver (every ask resets the latch before use). Called
+        on each ask; safe to call directly. Returns the number reclaimed."""
+        with self._lock:
+            retired = list(self._promise_retired)
+        if not retired:
+            return 0
+        base = self._promise_block * self.eps
+        rows = np.asarray([base + s for s in retired], np.int32)
+        landed = np.asarray(
+            self.system.read_state("__promise_replied", rows))
+        freed = [s for s, ok in zip(retired, landed) if bool(ok)]
+        with self._lock:
+            for s in freed:
+                self._promise_retired.remove(s)
+                self._promise_free.append(s)
+        return len(freed)
 
     # ------------------------------------------------------------ addressing
     def shard_of(self, entity_id: str) -> int:

@@ -374,11 +374,16 @@ def bench_modes(n, steps):
     artifact so kernel claims are checkable (VERDICT r2 weak #3): the three
     dynamic delivery modes (ops/segment.py deliver: merge-marker reduction /
     sort-segment / scatter-add) and the slots-mode ordered mailbox
-    (deliver_slots) against the reduce default."""
+    (deliver_slots) against the reduce default. `*_reference` rows rerun
+    merge and slots on the frozen wide-sort kernels, and `attribution`
+    carries the per-phase ms (key-sort / rank / place / reduce, plus the
+    wide sort they replace) so every crossover claim in
+    docs/DELIVERY_KERNELS.md traces to an artifact line."""
     import jax.numpy as jnp
     from akka_tpu.batched import BatchedSystem, Emit, behavior
     from akka_tpu.models.baseline_benches import (PAYLOAD_W, ring_behavior,
                                                   seed_ring_full)
+    from akka_tpu.ops.segment import delivery_attribution
 
     out = {}
 
@@ -397,6 +402,14 @@ def bench_modes(n, steps):
         s.spawn_block(ring_behavior, n)
         out[mode] = time_sys(s)
 
+    # same merge-mode ring on the frozen wide-sort kernels: the artifact
+    # itself carries the ranked-vs-reference delta the docs cite
+    s = BatchedSystem(capacity=n, behaviors=[ring_behavior],
+                      payload_width=PAYLOAD_W, host_inbox=8,
+                      delivery="merge", delivery_backend="reference")
+    s.spawn_block(ring_behavior, n)
+    out["merge_reference"] = time_sys(s)
+
     @behavior("ring-slots-bench", {"received": ((), jnp.int32)}, inbox="slots")
     def ring_slots(state, mailbox, ctx):
         inbox = mailbox.reduce()
@@ -405,10 +418,16 @@ def bench_modes(n, steps):
                 Emit.single(nxt, inbox.sum, 1, PAYLOAD_W,
                             when=inbox.count > 0))
 
-    s = BatchedSystem(capacity=n, behaviors=[ring_slots],
-                      payload_width=PAYLOAD_W, host_inbox=8, mailbox_slots=2)
-    s.spawn_block(ring_slots, n)
-    out["slots"] = time_sys(s)
+    for name, backend in (("slots", None), ("slots_reference", "reference")):
+        s = BatchedSystem(capacity=n, behaviors=[ring_slots],
+                          payload_width=PAYLOAD_W, host_inbox=8,
+                          mailbox_slots=2, delivery_backend=backend)
+        s.spawn_block(ring_slots, n)
+        out[name] = time_sys(s)
+
+    # per-phase attribution at this run's inbox size (n emissions + host
+    # rows), so each kernel choice is justified by a number in the artifact
+    out["attribution"] = delivery_attribution(n + 8, n, p=PAYLOAD_W, slots=2)
     return out
 
 
@@ -504,6 +523,9 @@ def main() -> None:
         if name == "modes":
             extra["modes"] = out
             for m, r in out.items():
+                if "msgs_per_sec" not in r:  # attribution row
+                    print(f"[bench] modes.{m}: {r}", file=sys.stderr)
+                    continue
                 print(f"[bench] modes.{m}: {r['msgs_per_sec']/1e6:.1f}M msg/s "
                       f"({r['ms_per_step']} ms/step) "
                       f"correct={'OK' if r['ok'] else 'FAIL'}",
@@ -571,7 +593,8 @@ def main() -> None:
                     "extra": {"stream": out, **extra}}))
             elif args.config == "modes":
                 out = bench_modes(n, mode_steps)
-                best = max(r["msgs_per_sec"] for r in out.values())
+                best = max(r["msgs_per_sec"] for r in out.values()
+                           if "msgs_per_sec" in r)
                 print(json.dumps({
                     "metric": "delivery-mode comparison, dynamic ring "
                               "(best mode)" + scale_tag,

@@ -1,0 +1,57 @@
+"""Tier-1 smoke for the delivery-kernel bench surface (bench.py --config
+modes): at tiny scale, the modes table must carry the per-phase attribution
+fields the docs cite, and slots-mode ordered delivery must stay within a
+fixed regression budget of the scatter reduction — the 350x slots/merge gap
+this rewrite closed must not silently reopen."""
+
+import numpy as np
+
+import bench
+
+
+# slots does strictly more work than scatter (per-message placement, FIFO,
+# spill bookkeeping). Pre-rewrite the ratio was ~500x at full scale; the
+# ranked kernels hold it to low single digits. The budget is generous so a
+# noisy CI box cannot flake, while a wide-sort regression (two orders of
+# magnitude) still fails loudly.
+SLOTS_VS_SCATTER_BUDGET = 12.0
+
+
+def test_modes_smoke_attribution_and_slots_budget():
+    out = bench.bench_modes(n=2048, steps=6)
+
+    for mode in ("merge", "sort", "scatter", "merge_reference", "slots",
+                 "slots_reference"):
+        assert out[mode]["ok"], (mode, out[mode])
+        assert out[mode]["msgs_per_sec"] > 0
+
+    att = out["attribution"]
+    for field in ("key_sort_ms", "rank_ms", "place_ms", "reduce_ms",
+                  "wide_sort_ms", "total_ms", "platform", "m", "n"):
+        assert field in att, f"attribution missing {field}: {att}"
+    assert att["total_ms"] > 0
+    # the phases are the decomposition of the ranked pipeline: their sum
+    # tracks the total (same jit granularity, so only rounding drift)
+    phase_sum = (att["key_sort_ms"] + att["rank_ms"] + att["place_ms"]
+                 + att["reduce_ms"])
+    assert 0.5 * phase_sum <= att["total_ms"] <= 2.0 * phase_sum
+
+    ratio = out["slots"]["ms_per_step"] / out["scatter"]["ms_per_step"]
+    assert ratio <= SLOTS_VS_SCATTER_BUDGET, (
+        f"slots {out['slots']['ms_per_step']}ms/step vs scatter "
+        f"{out['scatter']['ms_per_step']}ms/step: ratio {ratio:.1f} blew "
+        f"the {SLOTS_VS_SCATTER_BUDGET}x budget — ordered delivery has "
+        f"regressed toward the wide-sort kernels")
+
+
+def test_modes_smoke_ranked_beats_reference():
+    """The reason the backend seam exists: at any scale, ranked merge and
+    slots must not be SLOWER than the frozen wide-sort kernels they
+    replace (equal is fine at trivial sizes)."""
+    out = bench.bench_modes(n=4096, steps=4)
+    assert (out["merge"]["ms_per_step"]
+            <= 1.5 * out["merge_reference"]["ms_per_step"])
+    assert (out["slots"]["ms_per_step"]
+            <= 1.5 * out["slots_reference"]["ms_per_step"])
+    recv_ok = [out[k]["ok"] for k in out if "msgs_per_sec" in out[k]]
+    assert all(recv_ok)

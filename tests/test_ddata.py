@@ -494,3 +494,55 @@ def test_delta_gap_falls_back_to_gossip_without_data_loss(ddata_nodes):
     await_condition(lambda: state_on_1() == frozenset({"a"}), max_time=10.0,
                     message=f"expected exactly {{'a'}}: {state_on_1()}")
     assert state_on_1() == frozenset({"a"})  # gapped op never applied
+
+
+def test_remote_delete_prunes_delta_cursors(ddata_nodes):
+    """A key deleted REMOTELY (the tombstone arrives via replicated _Write /
+    gossip, not a local Delete call) must drop the key's delta bookkeeping
+    on the receiving replica — `_delta_seen`/`_delta_gapped` cursors and the
+    pending-delta buffers would otherwise grow with key churn, and stale
+    gossip must not re-add a cursor for a dead key."""
+    from akka_tpu.ddata.replicator import DELETED, _Gossip
+    systems, dd = ddata_nodes
+    key = Key("churned")
+    me = _node_id(systems[0])
+    p = TestProbe(systems[0])
+    dd[0].replicator.tell(
+        Update(key, ORSet.empty(), WriteAll(5.0),
+               modify=lambda s: s.add(me, "a")), p.ref)
+    p.expect_msg_class(UpdateSuccess, 6.0)
+
+    # delete on node 0: nodes 1/2 only ever see the tombstone remotely
+    dd[0].replicator.tell(Delete(key, WriteAll(5.0)), p.ref)
+    p.expect_msg_class(DeleteSuccess, 6.0)
+
+    def pruned_everywhere():
+        for i in (1, 2):
+            rep = dd[i].replicator.cell.actor
+            if rep.data.get(key.id) != DELETED:
+                return False
+            if any(pr[2] == key.id for pr in rep._delta_seen):
+                return False
+            if any(pr[2] == key.id for pr in rep._delta_gapped):
+                return False
+            if key.id in rep.deltas or key.id in rep.delta_seq:
+                return False
+        return True
+    await_condition(pruned_everywhere, max_time=10.0)
+
+    # forged stale gossip: the dead key rides in WITH a delta cursor. The
+    # tombstone must win and no cursor may be re-created for it.
+    stale = ORSet.empty().add(me, "zombie")
+    dd[1].replicator.tell(
+        _Gossip({key.id: stale}, want_keys=(),
+                from_addr=str(systems[0].provider.local_address),
+                tombstones={}, delta_seq={key.id: 7},
+                origin_uid="stale-uid"),
+        dd[0].replicator)
+    # a Get round-trip on the same mailbox orders after the gossip
+    p1 = TestProbe(systems[1])
+    dd[1].replicator.tell(Get(key, ReadLocal()), p1.ref)
+    assert isinstance(p1.receive_one(3.0), GetDataDeleted)
+    rep1 = dd[1].replicator.cell.actor
+    assert not any(pr[2] == key.id for pr in rep1._delta_seen)
+    assert not any(pr[2] == key.id for pr in rep1._delta_gapped)

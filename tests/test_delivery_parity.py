@@ -1,0 +1,197 @@
+"""Bit-parity suite for the rank-then-scatter delivery kernels.
+
+The ranked kernels (ops/segment.py `_deliver_ranked` /
+`_deliver_slots_ranked`) are a PERFORMANCE rewrite behind the
+`delivery_backend` seam; the frozen wide-sort kernels are the semantic
+contract. Every field of every Delivery/SlotDelivery result must be
+bit-identical between backends — not approximately equal: float summation
+order is part of the contract (the ranked reduce reconstructs the wide
+kernel's marker-interleaved cumsum layout exactly so XLA picks the same
+scan tree). These tests sweep dtypes, M/N/P shapes, spill overflow, the
+drop bucket, and both rank strategies, and pin the slots FIFO invariants
+against a numpy oracle.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from akka_tpu.ops import segment as sg
+
+RNG = np.random.default_rng(20260805)
+
+
+def _case(m, n, p, dtype=np.float32, frac_bad=0.15):
+    dst = RNG.integers(-2, n + 2, size=m).astype(np.int32)  # strays included
+    ok = RNG.random(m) > frac_bad
+    if np.issubdtype(np.dtype(dtype) if dtype != jnp.bfloat16 else np.float32,
+                     np.integer):
+        payload = RNG.integers(-50, 50, size=(m, p)).astype(dtype)
+        payload = jnp.asarray(payload)
+    else:
+        payload = jnp.asarray(
+            RNG.standard_normal((m, p)).astype(np.float32)).astype(dtype)
+    return jnp.asarray(dst), payload, jnp.asarray(ok)
+
+
+def _assert_fields_identical(a, b, ctx):
+    for f in a._fields:
+        x, y = np.asarray(getattr(a, f)), np.asarray(getattr(b, f))
+        assert x.dtype == y.dtype, (ctx, f, x.dtype, y.dtype)
+        assert np.array_equal(x, y), (
+            f"{ctx}: field {f!r} differs between backends "
+            f"(ref {x.ravel()[:8]} vs ranked {y.ravel()[:8]})")
+
+
+# ---------------------------------------------------------------- reduce
+
+REDUCE_SHAPES = [(257, 64, 3), (1024, 128, 4), (4096, 1000, 2),
+                 (65, 7, 1), (5000, 16, 5), (33, 1, 2)]
+
+
+@pytest.mark.parametrize("m,n,p", REDUCE_SHAPES)
+@pytest.mark.parametrize("style", ["merge", "sort"])
+@pytest.mark.parametrize("need_max", [False, True])
+def test_reduce_parity(m, n, p, style, need_max):
+    dst, payload, ok = _case(m, n, p)
+    ref = sg.deliver(dst, payload, ok, n, need_max=need_max, mode=style,
+                     backend="reference")
+    new = sg.deliver(dst, payload, ok, n, need_max=need_max, mode=style,
+                     backend="xla")
+    _assert_fields_identical(ref, new, f"reduce {style} m={m} n={n} p={p}")
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.int32, jnp.bfloat16])
+def test_reduce_parity_dtypes(dtype):
+    dst, payload, ok = _case(1024, 64, 4, dtype=dtype)
+    for style in ("merge", "sort"):
+        ref = sg.deliver(dst, payload, ok, 64, need_max=True, mode=style,
+                         backend="reference")
+        new = sg.deliver(dst, payload, ok, 64, need_max=True, mode=style,
+                         backend="xla")
+        _assert_fields_identical(ref, new, f"reduce {style} dtype={dtype}")
+
+
+def test_reduce_parity_all_invalid_and_all_one_actor():
+    # drop-bucket edge: every row invalid or out of range
+    dst = jnp.asarray(np.full(128, -1, np.int32))
+    payload = jnp.asarray(RNG.standard_normal((128, 3)).astype(np.float32))
+    ok = jnp.asarray(np.zeros(128, bool))
+    for style in ("merge", "sort"):
+        ref = sg.deliver(dst, payload, ok, 8, mode=style, backend="reference")
+        new = sg.deliver(dst, payload, ok, 8, mode=style, backend="xla")
+        _assert_fields_identical(ref, new, f"reduce {style} all-invalid")
+    # the opposite extreme: every message on ONE hot actor (summation-order
+    # torture — the whole batch folds into a single segment)
+    dst = jnp.asarray(np.full(4096, 3, np.int32))
+    payload = jnp.asarray(RNG.standard_normal((4096, 4)).astype(np.float32))
+    ok = jnp.asarray(np.ones(4096, bool))
+    for style in ("merge", "sort"):
+        ref = sg.deliver(dst, payload, ok, 8, mode=style, backend="reference")
+        new = sg.deliver(dst, payload, ok, 8, mode=style, backend="xla")
+        _assert_fields_identical(ref, new, f"reduce {style} one-hot-actor")
+
+
+def test_stable_ranks_strategies_agree():
+    """The packed single-operand rank strategy (cpu) and the 2-operand
+    sort fallback must produce identical ranks/counts — the fallback is
+    what TPU/GPU and the packing-overflow guard run."""
+    for m, n in [(257, 16), (1024, 64), (65, 1), (4096, 1000)]:
+        key = jnp.asarray(RNG.integers(0, n + 1, size=m).astype(np.int32))
+        r_cpu, c_cpu = sg.stable_ranks(key, n, platform="cpu")
+        r_gen, c_gen = sg.stable_ranks(key, n, platform="tpu")
+        np.testing.assert_array_equal(np.asarray(r_cpu), np.asarray(r_gen))
+        np.testing.assert_array_equal(np.asarray(c_cpu), np.asarray(c_gen))
+
+
+# ---------------------------------------------------------------- slots
+
+SLOT_CASES = [
+    dict(m=257, n=16, p=3, slots=2, cap=0, kind=False, susp=False),
+    dict(m=1024, n=64, p=4, slots=3, cap=64, kind=False, susp=False),
+    dict(m=2048, n=32, p=2, slots=2, cap=16, kind=True, susp=True),
+    dict(m=4096, n=100, p=4, slots=1, cap=8, kind=True, susp=True),
+    dict(m=333, n=8, p=1, slots=4, cap=4, kind=True, susp=True),  # overflow
+    dict(m=96, n=96, p=2, slots=2, cap=8, kind=True, susp=False),
+]
+
+
+@pytest.mark.parametrize("case", SLOT_CASES,
+                         ids=[f"m{c['m']}n{c['n']}cap{c['cap']}"
+                              for c in SLOT_CASES])
+@pytest.mark.parametrize("need_max", [False, True])
+def test_slots_parity(case, need_max):
+    m, n, p, slots, cap = (case["m"], case["n"], case["p"], case["slots"],
+                           case["cap"])
+    dst, payload, ok = _case(m, n, p)
+    mtype = jnp.asarray(RNG.integers(1, 5, size=m).astype(np.int32))
+    kind = jnp.asarray(RNG.random(n) > 0.5) if case["kind"] else None
+    susp = jnp.asarray(RNG.random(n) > 0.7) if case["susp"] else None
+    ref = sg.deliver_slots(dst, mtype, payload, ok, n, slots,
+                           need_max=need_max, spill_cap=cap,
+                           slots_kind=kind, suspended=susp,
+                           backend="reference")
+    new = sg.deliver_slots(dst, mtype, payload, ok, n, slots,
+                           need_max=need_max, spill_cap=cap,
+                           slots_kind=kind, suspended=susp, backend="xla")
+    _assert_fields_identical(ref, new, f"slots {case}")
+
+
+def test_slots_spill_overflow_drops_counted_identically():
+    """Force more spill demand than spill_cap: the overflow count and the
+    retained prefix must match the reference exactly (spill region order is
+    actor-major, FIFO within actor)."""
+    m, n, p, slots, cap = 512, 4, 2, 1, 8  # ~128 msgs/actor, 1 slot, cap 8
+    dst = jnp.asarray(RNG.integers(0, n, size=m).astype(np.int32))
+    payload = jnp.asarray(RNG.standard_normal((m, p)).astype(np.float32))
+    ok = jnp.asarray(np.ones(m, bool))
+    mtype = jnp.asarray(np.ones(m, np.int32))
+    kind = jnp.asarray(np.ones(n, bool))  # every actor spills its overflow
+    ref = sg.deliver_slots(dst, mtype, payload, ok, n, slots,
+                           spill_cap=cap, slots_kind=kind,
+                           backend="reference")
+    new = sg.deliver_slots(dst, mtype, payload, ok, n, slots,
+                           spill_cap=cap, slots_kind=kind, backend="xla")
+    _assert_fields_identical(ref, new, "slots spill-overflow")
+    assert int(np.asarray(new.dropped)) > 0  # the case really overflowed
+
+
+def test_slots_fifo_oracle_ranked():
+    """Ranked slots delivery against a plain-python oracle: per-actor FIFO
+    (arrival order) in the mailbox slots, consumed counts, and sums."""
+    m, n, p, slots = 400, 13, 3, 4
+    dst = RNG.integers(0, n, size=m).astype(np.int32)
+    mtype = RNG.integers(1, 5, size=m).astype(np.int32)
+    payload = RNG.standard_normal((m, p)).astype(np.float32)
+    ok = RNG.random(m) > 0.1
+    out = sg.deliver_slots(jnp.asarray(dst), jnp.asarray(mtype),
+                           jnp.asarray(payload), jnp.asarray(ok), n, slots,
+                           need_max=True, backend="xla")
+    types, pl = np.asarray(out.types), np.asarray(out.payload)
+    vv, counts = np.asarray(out.valid), np.asarray(out.count)
+    for a in range(n):
+        idx = [i for i in range(m) if ok[i] and dst[i] == a]
+        assert counts[a] == len(idx)
+        for j in range(slots):
+            if j < min(len(idx), slots):
+                assert vv[a, j]
+                assert types[a, j] == mtype[idx[j]]
+                np.testing.assert_array_equal(pl[a, j], payload[idx[j]])
+            else:
+                assert not vv[a, j]
+
+
+def test_backend_seam_roundtrip():
+    """set/get_delivery_backend steer the dispatcher; unknown names are
+    rejected loudly (a typo must not silently fall back)."""
+    assert sg.get_delivery_backend() in sg.DELIVERY_BACKENDS
+    prev = sg.get_delivery_backend()
+    try:
+        for b in sg.DELIVERY_BACKENDS:
+            sg.set_delivery_backend(b)
+            assert sg.get_delivery_backend() == b
+        with pytest.raises(ValueError):
+            sg.set_delivery_backend("pallas-someday")
+    finally:
+        sg.set_delivery_backend(prev)

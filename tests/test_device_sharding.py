@@ -353,3 +353,39 @@ def test_init_device_via_typed_api():
     finally:
         system.terminate()
         system.await_termination(10)
+
+
+def test_ask_timeout_slot_reclaimed_after_late_reply():
+    """A timed-out ask retires its promise slot so the straggler reply
+    cannot answer a future ask — but retirement is a parking lot, not a
+    leak: once the `__promise_replied` latch shows the late reply landed,
+    the slot returns to the free list and asks keep working."""
+    from akka_tpu.batched.bridge import reply_dst
+
+    @behavior("late-echo", {"asked": ((), jnp.int32)})
+    def echo(state, inbox, ctx):
+        return ({"asked": state["asked"] + inbox.count},
+                Emit.single(reply_dst(inbox.sum), inbox.sum, 1, P,
+                            when=inbox.count > 0))
+
+    region = DeviceShardRegion(DeviceEntity(
+        "late-ask", echo, n_shards=4, entities_per_shard=16,
+        payload_width=P, host_inbox_per_shard=8))
+    region.allocate_all()
+    free0 = len(region._promise_free)
+    with np.testing.assert_raises(TimeoutError):
+        # one step sends the request; the reply is still riding the
+        # exchange when the budget runs out
+        region.ask(0, 3, [5.0], steps=1, max_extra_steps=0)
+    assert len(region._promise_free) == free0 - 1
+    assert len(region._promise_retired) == 1  # parked, not dropped
+
+    region.run(4)  # let the straggler reply land in the retired row
+    region.block_until_ready()
+    assert region._reclaim_promise_slots() == 1
+    assert len(region._promise_free) == free0
+    assert region._promise_retired == []
+
+    # the recycled pool answers fresh asks with the right payload
+    reply = region.ask(0, 3, [7.0, 0, 0])
+    assert reply[0] == 7.0

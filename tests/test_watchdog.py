@@ -122,3 +122,41 @@ def test_git_commit_logs_when_nothing_exists(watchdog):
                for line in (tmp / "TPU_PROBELOG.jsonl").read_text()
                .splitlines()]
     assert any("no artifacts exist" in e["detail"] for e in entries)
+
+
+def test_timeout_clears_stale_out_and_keeps_partial_output(watchdog, monkeypatch):
+    """A timed-out capture must not leave the PREVIOUS run's .out readable
+    as this run's output, and whatever the child printed before the kill
+    is persisted — the only clue to where a hung run got stuck."""
+    wd, tmp = watchdog
+    stale = tmp / "watchdog_bench_full.out"
+    stale.write_text("old numbers from a finished run\n")
+
+    def fake_run(cmd, **kw):
+        raise subprocess.TimeoutExpired(
+            cmd, kw.get("timeout"), output=b"compiled ok\nstep 3...",
+            stderr=b"still tracing")
+
+    monkeypatch.setattr(wd.subprocess, "run", fake_run)
+    assert not wd.run_logged("bench_full", ["sleep", "999"], timeout_s=1.0)
+    txt = stale.read_text()
+    assert "old numbers" not in txt
+    assert "step 3..." in txt
+    assert "still tracing" in txt
+    assert "timed out" in txt
+
+
+def test_timeout_with_no_captured_output_removes_stale_out(watchdog, monkeypatch):
+    """TimeoutExpired may carry no output at all (killed before the pipes
+    filled); the stale file must STILL be gone so a later parse can't pick
+    it up as fresh."""
+    wd, tmp = watchdog
+    stale = tmp / "watchdog_bench_full.out"
+    stale.write_text('{"metric": "ghost", "value": 1}\n')
+
+    def fake_run(cmd, **kw):
+        raise subprocess.TimeoutExpired(cmd, kw.get("timeout"))
+
+    monkeypatch.setattr(wd.subprocess, "run", fake_run)
+    assert not wd.run_logged("bench_full", ["sleep", "999"], timeout_s=1.0)
+    assert "ghost" not in stale.read_text()

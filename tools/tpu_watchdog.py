@@ -75,14 +75,32 @@ def run_logged(name: str, cmd: list[str], timeout_s: float) -> bool:
     # CPU numbers committed as TPU artifacts — the opposite of the tool's
     # purpose
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    out_path = os.path.join(REPO, f"watchdog_{name}.out")
+    # drop the previous run's capture BEFORE launching: a timed-out or
+    # crashed run must not leave a stale .out behind that reads as this
+    # run's output (and could get committed as a fresh artifact)
+    try:
+        os.remove(out_path)
+    except FileNotFoundError:
+        pass
     try:
         r = subprocess.run(cmd, cwd=REPO, timeout=timeout_s,
                            capture_output=True, text=True, env=env)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # keep whatever the child printed before the kill — partial output
+        # is the only clue to WHERE a hung capture run got stuck
+        def _txt(b):
+            if b is None:
+                return ""
+            return b if isinstance(b, str) else b.decode("utf-8", "replace")
+        with open(out_path, "w") as f:
+            f.write(_txt(e.stdout))
+            f.write(f"\n--- stderr (partial: timed out "
+                    f"after {timeout_s:.0f}s) ---\n")
+            f.write(_txt(e.stderr))
         append_log({"ts": _utcnow(), "ok": False,
                     "detail": f"{name} timed out after {timeout_s:.0f}s"})
         return False
-    out_path = os.path.join(REPO, f"watchdog_{name}.out")
     with open(out_path, "w") as f:
         f.write(r.stdout)
         f.write("\n--- stderr ---\n")
