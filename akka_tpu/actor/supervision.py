@@ -18,6 +18,12 @@ from .messages import (ActorInitializationException, ActorKilledException,
 
 
 class Directive(Enum):
+    """Resume/Restart/Stop/Escalate (FaultHandling.scala). Shared with the
+    batched device runtime: a BatchedBehavior's LaneSupervisor
+    (batched/supervision.py) maps each Directive to a lane code and applies
+    it as masked column ops inside the jitted step — same semantics,
+    step-count time base instead of wall clock (docs/SUPERVISION.md)."""
+
     RESUME = "resume"
     RESTART = "restart"
     STOP = "stop"

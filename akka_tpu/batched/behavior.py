@@ -147,6 +147,14 @@ class BatchedBehavior:
     A slots-mode system runs both kinds (reduce behaviors get
     `mailbox.reduce()`); a reduce-mode system rejects slots behaviors.
     Runs only for actors whose `count > 0` unless `always_on`.
+
+    `supervisor` (batched/supervision.py LaneSupervisor) compiles a
+    fault-handling directive into the step: lanes raising `_failed` are
+    resumed/restarted/stopped/escalated in-graph, no host round-trip.
+    `nonfinite_guard` (opt-in) marks a lane `_failed` when its new state
+    row contains NaN/Inf — the pre-failure state is retained, exactly like
+    a failing receive, instead of the NaN silently poisoning every
+    subsequent reduce.
     """
 
     name: str
@@ -154,6 +162,8 @@ class BatchedBehavior:
     receive: Callable[..., Tuple[Dict[str, jax.Array], Emit]]
     always_on: bool = False
     inbox: str = "reduce"  # "reduce" | "slots"
+    supervisor: Any = None  # Optional[supervision.LaneSupervisor]
+    nonfinite_guard: bool = False
 
     def init_state(self, n: int) -> Dict[str, jax.Array]:
         return {k: jnp.zeros((n,) + tuple(shape), dtype=dtype)
@@ -161,11 +171,14 @@ class BatchedBehavior:
 
 
 def behavior(name: str, state_spec: Dict[str, Tuple[Tuple[int, ...], Any]],
-             always_on: bool = False, inbox: str = "reduce"):
+             always_on: bool = False, inbox: str = "reduce",
+             supervisor: Any = None, nonfinite_guard: bool = False):
     """Decorator: @behavior("counter", {"count": ((), jnp.int32)})"""
 
     def deco(fn) -> BatchedBehavior:
         return BatchedBehavior(name=name, state_spec=state_spec, receive=fn,
-                               always_on=always_on, inbox=inbox)
+                               always_on=always_on, inbox=inbox,
+                               supervisor=supervisor,
+                               nonfinite_guard=nonfinite_guard)
 
     return deco

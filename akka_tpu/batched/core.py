@@ -48,6 +48,8 @@ import numpy as np
 
 from .behavior import BatchedBehavior
 from .step import StepCore
+from .supervision import (N_COUNTERS, SUP_COLUMNS, counts_dict,
+                          reserved_fill)
 
 
 def drive_pipelined(step_once: Callable[[], None],
@@ -126,17 +128,32 @@ class BatchedSystem:
                         f"behavior {b.name}: state column {col!r} conflicts "
                         f"({self.state_spec[col]} vs {spec})")
                 self.state_spec[col] = ((tuple(spec[0])), spec[1])
+        # in-graph supervision bookkeeping (batched/supervision.py): any
+        # supervised behavior pulls in the full column set; a bare
+        # nonfinite_guard only needs the error lane itself
+        if any(getattr(b, "supervisor", None) is not None for b in behaviors):
+            for col, spec in SUP_COLUMNS.items():
+                self.state_spec.setdefault(col, spec)
+        elif any(getattr(b, "nonfinite_guard", False) for b in behaviors):
+            self.state_spec.setdefault("_failed", SUP_COLUMNS["_failed"])
 
         n = self.capacity
         self.state: Dict[str, jax.Array] = {
             k: jnp.zeros((n,) + shape, dtype=dtype)
             for k, (shape, dtype) in self.state_spec.items()}
-        if "_become" in self.state:  # re-armed value is -1, not 0
-            self.state["_become"] = jnp.full_like(self.state["_become"], -1)
+        for col in self.state:  # _become/_restart_at re-arm to -1, not 0
+            if reserved_fill(col):
+                self.state[col] = jnp.full_like(self.state[col],
+                                                reserved_fill(col))
         self.behavior_id = jnp.zeros((n,), dtype=jnp.int32)
         self.alive = jnp.zeros((n,), dtype=jnp.bool_)
         self.step_count = jnp.asarray(0, jnp.int32)
         self.mail_dropped = jnp.asarray(0, jnp.int32)  # mailbox-slot overflow
+        # aggregate supervision counters (supervision.COUNTER_NAMES order),
+        # accumulated in-graph — reading them is the host's choice, never
+        # forced on the step path
+        self.sup_counts = jnp.zeros((N_COUNTERS,), jnp.int32)
+        self._sup_reported = np.zeros((N_COUNTERS,), np.int64)  # FR snapshot
 
         # inbox layout: [spill_cap | n*K emissions | host_inbox] — spill
         # first so redelivered (older) mail outranks fresh emissions in the
@@ -211,7 +228,7 @@ class BatchedSystem:
         # (the tell->receive latency path pays per-dispatch overhead twice
         # otherwise — on a tunneled backend that is 2x the RTT)
         self._flush_step_jit = jax.jit(self._flush_step_impl,
-                                       donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+                                       donate_argnums=tuple(range(9)))
 
         self._core = StepCore(self.behaviors, n_local=self.capacity,
                               payload_width=self.payload_width,
@@ -226,9 +243,9 @@ class BatchedSystem:
         # constants would be baked into the HLO (multi-MB programs break
         # remote compile). Kind/scalars are trace-time constants.
         self._topo_arrays = topology.runtime_arrays() if topology is not None else ()
-        donate = (0, 1, 2, 3, 4, 5, 6, 7)
+        donate = tuple(range(9))  # everything but step_count
         self._step_jit = jax.jit(self._step_impl, donate_argnums=donate)
-        self._run_jit = jax.jit(self._run_impl, static_argnums=(9,),
+        self._run_jit = jax.jit(self._run_impl, static_argnums=(10,),
                                 donate_argnums=donate)
 
     # ------------------------------------------------------------- lifecycle
@@ -278,9 +295,8 @@ class BatchedSystem:
             rec_arr = np.asarray(recycled, np.int32)
             ridx = jnp.asarray(rec_arr)
             for col, arr in self.state.items():
-                fill = -1 if col == "_become" else 0
                 self.state[col] = arr.at[ridx].set(
-                    jnp.asarray(fill, arr.dtype))
+                    jnp.asarray(reserved_fill(col), arr.dtype))
             stale = jnp.isin(self.inbox_dst, ridx)
             self.inbox_valid = jnp.where(stale, False, self.inbox_valid)
             if self._stager is not None:
@@ -441,15 +457,16 @@ class BatchedSystem:
 
     def _flush_step_impl(self, state, behavior_id, alive, inbox_dst,
                          inbox_type, inbox_payload, inbox_valid,
-                         mail_dropped, step_count, dsts, mts, pls, valid,
-                         topo_arrays=()):
+                         mail_dropped, sup_counts, step_count, dsts, mts,
+                         pls, valid, topo_arrays=()):
         """flush + step as ONE program (the latency hot path)."""
         inbox_dst, inbox_type, inbox_payload, inbox_valid = self._flush_impl(
             inbox_dst, inbox_type, inbox_payload, inbox_valid,
             dsts, mts, pls, valid)
         return self._step_impl(state, behavior_id, alive, inbox_dst,
                                inbox_type, inbox_payload, inbox_valid,
-                               mail_dropped, step_count, topo_arrays)
+                               mail_dropped, sup_counts, step_count,
+                               topo_arrays)
 
     def _drain_to_pad(self) -> int:
         """Drain staged host tells (native stager or Python list) into the
@@ -498,12 +515,13 @@ class BatchedSystem:
 
     # ------------------------------------------------------------------ step
     def _step_impl(self, state, behavior_id, alive, inbox_dst, inbox_type,
-                   inbox_payload, inbox_valid, mail_dropped, step_count,
-                   topo_arrays=()):
+                   inbox_payload, inbox_valid, mail_dropped, sup_counts,
+                   step_count, topo_arrays=()):
         n = self.capacity
         sc = self.spill_cap
         nk = n * self.out_degree
-        new_state, behavior_id, emits, dropped, spill = self._core.run_local(
+        (new_state, behavior_id, alive, emits, dropped, spill,
+         sup_delta) = self._core.run_local(
             state, behavior_id, alive, inbox_dst, inbox_type, inbox_payload,
             inbox_valid, step_count, topo_arrays)
 
@@ -537,28 +555,29 @@ class BatchedSystem:
             new_inbox_valid = new_inbox_valid.at[:sc].set(sp_v)
         return (new_state, behavior_id, alive, new_inbox_dst, new_inbox_type,
                 new_inbox_payload, new_inbox_valid, mail_dropped + dropped,
-                step_count + 1)
+                sup_counts + sup_delta, step_count + 1)
 
     def _run_impl(self, state, behavior_id, alive, inbox_dst, inbox_type,
-                  inbox_payload, inbox_valid, mail_dropped, step_count,
-                  n_steps: int, topo_arrays=()):
+                  inbox_payload, inbox_valid, mail_dropped, sup_counts,
+                  step_count, n_steps: int, topo_arrays=()):
         def body(carry, _):
             return self._step_impl(*carry, topo_arrays), None
 
         carry = (state, behavior_id, alive, inbox_dst, inbox_type,
-                 inbox_payload, inbox_valid, mail_dropped, step_count)
+                 inbox_payload, inbox_valid, mail_dropped, sup_counts,
+                 step_count)
         carry, _ = jax.lax.scan(body, carry, None, length=n_steps)
         return carry
 
     def _carry(self):
         return (self.state, self.behavior_id, self.alive, self.inbox_dst,
                 self.inbox_type, self.inbox_payload, self.inbox_valid,
-                self.mail_dropped, self.step_count)
+                self.mail_dropped, self.sup_counts, self.step_count)
 
     def _set_carry(self, carry) -> None:
         (self.state, self.behavior_id, self.alive, self.inbox_dst,
          self.inbox_type, self.inbox_payload, self.inbox_valid,
-         self.mail_dropped, self.step_count) = carry
+         self.mail_dropped, self.sup_counts, self.step_count) = carry
 
     def step(self) -> None:
         """One delivery+update step. Staged host tells ride INSIDE the same
@@ -586,6 +605,7 @@ class BatchedSystem:
             if k > 0:
                 fr.device_flush("batched", k)
             fr.device_step("batched", 1, _time.perf_counter() - t0)
+            self._report_supervision(fr)
 
     def run(self, n_steps: int) -> None:
         """n steps fully on device (lax.scan) — the bench hot loop."""
@@ -598,6 +618,7 @@ class BatchedSystem:
         fr = self.flight_recorder
         if fr is not None:
             fr.device_step("batched", n_steps, _time.perf_counter() - t0)
+            self._report_supervision(fr)
 
     def run_pipelined(self, n_steps: int, depth: int = 2) -> None:
         """n SEPARATE single-step dispatches with up to `depth` programs in
@@ -671,13 +692,60 @@ class BatchedSystem:
         """Host-mediated restart-with-reset-state: zero the rows' state
         (reserved columns re-armed), clear the failure flag, keep the
         behavior (preRestart/postRestart with a fresh instance —
-        ActorCell.scala:589-602 faultRecreate analogue)."""
+        ActorCell.scala:589-602 faultRecreate analogue). A restart is a
+        NEW incarnation: the rows' generation bumps, so a tell whose
+        expect_gen was captured before the restart dead-letters instead
+        of reaching the restarted occupant (path-uid parity with
+        stop_block)."""
         from .step import fault_restart_rows
         self.state = fault_restart_rows(self.state, ids, init_state)
+        arr = np.unique(np.atleast_1d(np.asarray(ids, np.int32)))
+        with self._lock:
+            self._generation[arr] += 1
 
     def clear_failed(self, ids) -> None:
         from .step import fault_clear_failed
         self.state = fault_clear_failed(self.state, ids)
+
+    # ---------------------------------------------- in-graph supervision
+    @property
+    def supervision_counts(self) -> Dict[str, int]:
+        """Aggregate in-graph supervision counters (failed/resumed/
+        restarted/stopped/escalated/dead_letters) accumulated by the jitted
+        step. Reading is a host read of 6 int32s — the host's choice of
+        sync point, never forced on the step path."""
+        return counts_dict(self.sup_counts)
+
+    def any_escalated(self) -> bool:
+        """ONE device scalar: did any supervised lane escalate? The cheap
+        aggregate check the host polls at ITS cadence (the escalation
+        analogue of any_failed)."""
+        if "_escalated" not in self.state:
+            return False
+        return bool(jax.device_get(jnp.any(self.state["_escalated"])))
+
+    def escalated_rows(self) -> np.ndarray:
+        """Rows whose supervisor escalated (suspended, awaiting host
+        resolution via restart_rows/clear_failed/stop_block)."""
+        if "_escalated" not in self.state:
+            return np.empty((0,), np.int32)
+        flags = np.asarray(jax.device_get(self.state["_escalated"]))
+        return np.nonzero(flags)[0].astype(np.int32)
+
+    def _report_supervision(self, fr) -> None:
+        """Emit the supervision-counter DELTA since the last report to the
+        flight recorder (one small device read; only runs when a recorder
+        is attached AND supervision is compiled in)."""
+        if not self._core.sup.active:
+            return
+        totals = np.asarray(jax.device_get(self.sup_counts), np.int64)
+        delta = totals - self._sup_reported
+        if not delta.any():
+            return
+        self._sup_reported = totals
+        fr.device_supervision("batched",
+                              int(jax.device_get(self.step_count)),
+                              *(int(x) for x in delta))
 
     def set_behavior(self, ids, behavior: BatchedBehavior | int) -> None:
         """Host-side become: rewrite the rows' behavior index."""

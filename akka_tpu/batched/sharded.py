@@ -50,6 +50,8 @@ from ..ops.segment import exchange_uses_ranked, stable_ranks
 from ..parallel.mesh import make_mesh
 from .behavior import BatchedBehavior
 from .step import StepCore
+from .supervision import (N_COUNTERS, SUP_COLUMNS, counts_dict,
+                          reserved_fill)
 
 
 class ShardedBatchedSystem:
@@ -125,14 +127,25 @@ class ShardedBatchedSystem:
                 if col in self.state_spec and self.state_spec[col] != spec:
                     raise ValueError(f"conflicting column {col!r}")
                 self.state_spec[col] = (tuple(spec[0]), spec[1])
+        # in-graph supervision columns (batched/supervision.py): sharded
+        # with the state, so supervision bookkeeping survives the exchange
+        # and a rebalance relocating a failed lane moves its retry/backoff
+        # state with it
+        if any(getattr(b, "supervisor", None) is not None for b in behaviors):
+            for col, spec in SUP_COLUMNS.items():
+                self.state_spec.setdefault(col, spec)
+        elif any(getattr(b, "nonfinite_guard", False) for b in behaviors):
+            self.state_spec.setdefault("_failed", SUP_COLUMNS["_failed"])
 
         shard = NamedSharding(self.mesh, P(axis_name))
         n = self.capacity
         self.state = {k: jax.device_put(jnp.zeros((n,) + shape, dtype=dtype), shard)
                       for k, (shape, dtype) in self.state_spec.items()}
-        if "_become" in self.state:  # re-armed value is -1, not 0
-            self.state["_become"] = jax.device_put(
-                jnp.full((n,), -1, self.state_spec["_become"][1]), shard)
+        for col in self.state:  # _become/_restart_at re-arm to -1, not 0
+            if reserved_fill(col):
+                self.state[col] = jax.device_put(
+                    jnp.full((n,), reserved_fill(col),
+                             self.state_spec[col][1]), shard)
         self.behavior_id = jax.device_put(jnp.zeros((n,), jnp.int32), shard)
         self.alive = jax.device_put(jnp.zeros((n,), jnp.bool_), shard)
         # committed + replicated on the mesh from the start: an uncommitted
@@ -154,6 +167,10 @@ class ShardedBatchedSystem:
         self.dropped = jax.device_put(jnp.zeros((self.n_shards,), jnp.int32), shard)
         self.mail_dropped = jax.device_put(
             jnp.zeros((self.n_shards,), jnp.int32), shard)
+        # per-shard in-graph supervision counters ([n_shards, N_COUNTERS],
+        # COUNTER_NAMES order) — summed over shards on host read
+        self.sup_counts = jax.device_put(
+            jnp.zeros((self.n_shards, N_COUNTERS), jnp.int32), shard)
 
         self._next_row = 0
         self._lock = threading.Lock()
@@ -187,12 +204,13 @@ class ShardedBatchedSystem:
 
         def local_step(state, behavior_id, alive, inbox_dst, inbox_type,
                        inbox_payload, inbox_valid, dropped, mail_dropped,
-                       step_count, tables):
+                       sup_counts, step_count, tables):
             # shapes here are per-shard blocks
             shard_idx = jax.lax.axis_index(axis)
             base = shard_idx * n_local
 
-            new_state, behavior_id, emits, mdrop, spill = core.run_local(
+            (new_state, behavior_id, alive, emits, mdrop, spill,
+             sup_delta) = core.run_local(
                 state, behavior_id, alive, inbox_dst, inbox_type,
                 inbox_payload, inbox_valid, step_count,
                 dst_offset=base, id_base=base, tables=tables)
@@ -308,30 +326,32 @@ class ShardedBatchedSystem:
                 new_inbox_valid = new_inbox_valid.at[:sc].set(sp_v)
             new_dropped = dropped + n_dropped
             new_mail_dropped = mail_dropped + mdrop
+            new_sup_counts = sup_counts + sup_delta[None, :]
 
             return (new_state, behavior_id, alive, new_inbox_dst,
                     new_inbox_type, new_inbox_payload, new_inbox_valid,
-                    new_dropped, new_mail_dropped, step_count + 1)
+                    new_dropped, new_mail_dropped, new_sup_counts,
+                    step_count + 1)
 
         mesh = self.mesh
         state_specs = {k: P(axis) for k in self.state_spec}
         table_specs = {k: P() for k in self.tables}  # replicated, tiny
         in_specs = (state_specs, P(axis), P(axis), P(axis), P(axis), P(axis),
-                    P(axis), P(axis), P(axis), P(), table_specs)
+                    P(axis), P(axis), P(axis), P(axis), P(), table_specs)
         out_specs = (state_specs, P(axis), P(axis), P(axis), P(axis), P(axis),
-                     P(axis), P(axis), P(axis), P())
+                     P(axis), P(axis), P(axis), P(axis), P())
 
         sharded = shard_map(local_step, mesh=mesh, in_specs=in_specs,
                             out_specs=out_specs, check_vma=False)
 
         def multi_step(state, behavior_id, alive, inbox_dst, inbox_type,
                        inbox_payload, inbox_valid, dropped, mail_dropped,
-                       step_count, tables, n_steps: int):
+                       sup_counts, step_count, tables, n_steps: int):
             def body(carry, _):
                 return sharded(*carry, tables), None
             carry = (state, behavior_id, alive, inbox_dst, inbox_type,
                      inbox_payload, inbox_valid, dropped, mail_dropped,
-                     step_count)
+                     sup_counts, step_count)
             carry, _ = jax.lax.scan(body, carry, None, length=n_steps)
             return carry
 
@@ -343,9 +363,9 @@ class ShardedBatchedSystem:
         repl_s = NamedSharding(mesh, P())
         out_shardings = ({k: shard_s for k in self.state_spec},
                          shard_s, shard_s, shard_s, shard_s, shard_s,
-                         shard_s, shard_s, shard_s, repl_s)
-        return jax.jit(multi_step, static_argnums=(11,),
-                       donate_argnums=tuple(range(9)),
+                         shard_s, shard_s, shard_s, shard_s, repl_s)
+        return jax.jit(multi_step, static_argnums=(12,),
+                       donate_argnums=tuple(range(10)),
                        out_shardings=out_shardings)
 
     # ------------------------------------------------------------- lifecycle
@@ -507,11 +527,12 @@ class ShardedBatchedSystem:
         self._flush_staged()
         (self.state, self.behavior_id, self.alive, self.inbox_dst,
          self.inbox_type, self.inbox_payload, self.inbox_valid, self.dropped,
-         self.mail_dropped, self.step_count) = \
+         self.mail_dropped, self.sup_counts, self.step_count) = \
             self._step_fn(self.state, self.behavior_id, self.alive,
                           self.inbox_dst, self.inbox_type, self.inbox_payload,
                           self.inbox_valid, self.dropped, self.mail_dropped,
-                          self.step_count, self.tables, n_steps)
+                          self.sup_counts, self.step_count, self.tables,
+                          n_steps)
 
     step = run
 
@@ -547,6 +568,26 @@ class ShardedBatchedSystem:
     def clear_failed(self, ids) -> None:
         from .step import fault_clear_failed
         self.state = fault_clear_failed(self.state, ids)
+
+    # ---------------------------------------------- in-graph supervision
+    @property
+    def supervision_counts(self) -> Dict[str, int]:
+        """Aggregate in-graph supervision counters summed over shards
+        (see BatchedSystem.supervision_counts)."""
+        return counts_dict(self.sup_counts)
+
+    def any_escalated(self) -> bool:
+        """ONE device scalar: did any supervised lane escalate?"""
+        if "_escalated" not in self.state:
+            return False
+        return bool(jax.device_get(jnp.any(self.state["_escalated"])))
+
+    def escalated_rows(self) -> np.ndarray:
+        """Global ids of escalated lanes awaiting host resolution."""
+        if "_escalated" not in self.state:
+            return np.empty((0,), np.int32)
+        flags = np.asarray(jax.device_get(self.state["_escalated"]))
+        return np.nonzero(flags)[0].astype(np.int32)
 
     def stop_block(self, ids) -> None:
         """Mark rows dead (no free-list on the sharded runtime: spawn is

@@ -29,6 +29,8 @@ import jax.numpy as jnp
 from ..ops.segment import (Delivery, SlotDelivery, deliver, deliver_slots,
                            deliver_static)
 from .behavior import BatchedBehavior, Ctx, Emit, Inbox, Mailbox, _bshape
+from .supervision import (N_COUNTERS, SupervisionTables, apply_supervision,
+                          reserved_fill)
 
 
 class StepCore:
@@ -73,6 +75,10 @@ class StepCore:
         if self.slots > 0 and topology is not None:
             raise ValueError("StaticTopology routing is a reduce-mode "
                              "optimization; slots mode uses dynamic delivery")
+        # in-graph supervision tables (batched/supervision.py): trace-time
+        # [n_behaviors] parameter rows; sup.active == False keeps the whole
+        # supervision pass out of the program entirely
+        self.sup = SupervisionTables(self.behaviors)
         self._branches = [self._wrap(b) for b in self.behaviors]
         # which behaviors consume ordered slots: overflow past the slot cap
         # is a real drop only for these — reduce-kind recipients get every
@@ -109,6 +115,17 @@ class StepCore:
             merged = jax.tree.map(
                 lambda new, old: jnp.where(_bshape(active, new), new, old),
                 merged, dict(state_row))
+            if b.nonfinite_guard:
+                # opt-in non-finite guard: a new state row carrying NaN/Inf
+                # marks the lane failed — the update layer then DISCARDS it
+                # (pre-failure state retained, like any failing receive)
+                # instead of the NaN poisoning every subsequent reduce
+                bad = jnp.asarray(False)
+                for v in new_cols.values():
+                    if jnp.issubdtype(jnp.asarray(v).dtype, jnp.inexact):
+                        bad = bad | jnp.any(~jnp.isfinite(v))
+                merged["_failed"] = merged.get(
+                    "_failed", jnp.asarray(False)) | (bad & active)
             emit = Emit(dst=jnp.where(active, emit.dst, -1),
                         payload=emit.payload,
                         valid=emit.valid & active,
@@ -166,9 +183,13 @@ class StepCore:
     # -------------------------------------------------------------- update
     def update(self, state, behavior_id, alive, delivered, step_count,
                id_base=0, tables=()):
-        """Vmapped behavior switch over all local rows. Returns
-        (new_state, emits) with emits shaped [n_local, K(...)]. Dead rows
-        neither update nor emit."""
+        """Vmapped behavior switch over all local rows, then the in-graph
+        supervision pass. Returns (new_state, new_behavior_id, new_alive,
+        emits, sup_delta) with emits shaped [n_local, K(...)] and sup_delta
+        the [N_COUNTERS] int32 directive/dead-letter counter increment
+        (zeros when no behavior carries a supervisor). Dead rows neither
+        update nor emit; STOP-directive lanes come back dead in
+        new_alive."""
         n = self.n_local
         branches = self._branches
         ids = jnp.asarray(id_base, jnp.int32) + jnp.arange(n, dtype=jnp.int32)
@@ -235,28 +256,45 @@ class StepCore:
             new_state["_become"] = jnp.full_like(req, -1)
         else:
             new_behavior_id = behavior_id
-        return new_state, new_behavior_id, emits
+        # in-graph supervision: resolve this step's fresh failures (and any
+        # backoff restarts coming due) as masked lane ops — no host poll.
+        # Table lookups use the PRE-become behavior id: the failure happened
+        # under the behavior that was running when it was detected.
+        new_alive = alive
+        sup_delta = jnp.zeros((N_COUNTERS,), jnp.int32)
+        if self.sup.active and "_failed" in new_state:
+            new_state, new_alive, sup_delta = apply_supervision(
+                self.sup, new_state, behavior_id, alive,
+                old_failed=state["_failed"], delivered_count=d.count,
+                step=step_count)
+        return new_state, new_behavior_id, new_alive, emits, sup_delta
 
     def run_local(self, state, behavior_id, alive, inbox_dst, inbox_type,
                   inbox_payload, inbox_valid, step_count, topo_arrays=(),
                   dst_offset=None, id_base=0, tables=()):
         """deliver + update in one call. Returns (new_state, new_behavior_id,
-        emits, dropped, spill) where dropped is this step's REAL message-loss
-        count (0 in reduce mode — reductions never overflow; spill-region
-        overflow in slots mode) and spill is a (dst, type, payload, valid)
-        tuple of retained mail to re-inject at the FRONT of the next inbox
-        (spill dst is GLOBAL — dst_offset re-applied), or None when
-        spill_cap == 0."""
+        new_alive, emits, dropped, spill, sup_delta) where dropped is this
+        step's REAL message-loss count (0 in reduce mode — reductions never
+        overflow; spill-region overflow in slots mode), spill is a
+        (dst, type, payload, valid) tuple of retained mail to re-inject at
+        the FRONT of the next inbox (spill dst is GLOBAL — dst_offset
+        re-applied), or None when spill_cap == 0, and sup_delta is the
+        [N_COUNTERS] supervision counter increment."""
         slots_kind_row = suspended = None
         if self.slots > 0 and self.spill_cap > 0:
             slots_kind_row = self._slots_kind[behavior_id]
             if "_failed" in state:
                 # suspended = failed-but-restartable; dead rows' mail is
-                # discarded as before (no resurrection to wait for)
+                # discarded as before (no resurrection to wait for).
+                # Supervised lanes are EXCLUDED: their down-time mail is
+                # dead-lettered by the supervision pass (backoff contract),
+                # not retained for the next incarnation
                 suspended = state["_failed"] & alive
+                if self.sup.active:
+                    suspended = suspended & ~self.sup.enabled[behavior_id]
         d = self.deliver(inbox_dst, inbox_type, inbox_payload, inbox_valid,
                          topo_arrays, dst_offset, slots_kind_row, suspended)
-        new_state, new_behavior_id, emits = self.update(
+        new_state, new_behavior_id, alive, emits, sup_delta = self.update(
             state, behavior_id, alive, d, step_count, id_base, tables)
         spill = None
         if self.slots > 0 and self.spill_cap > 0:
@@ -273,7 +311,8 @@ class StepCore:
                                         over, 0)).astype(jnp.int32)
         else:
             dropped = jnp.asarray(0, jnp.int32)
-        return new_state, new_behavior_id, emits, dropped, spill
+        return (new_state, new_behavior_id, alive, emits, dropped, spill,
+                sup_delta)
 
 
 # -------------------------------------------------- shared fault handling
@@ -300,13 +339,18 @@ def fault_failed_rows(state):
 
 def fault_restart_rows(state, ids, init_state=None):
     """Restart-with-reset-state: zero the rows' columns (reserved columns
-    re-armed), returning the new state dict. Mutates nothing."""
+    re-armed), returning the new state dict. Mutates nothing. The device
+    incarnation counter `_gen` is PRESERVED AND BUMPED, not zeroed — a
+    host restart is a new incarnation just like an in-graph one."""
     import numpy as _np
     idx = jnp.asarray(_np.atleast_1d(_np.asarray(ids, _np.int32)))
     out = dict(state)
     for col, arr in out.items():
-        fill = -1 if col == "_become" else 0
-        out[col] = arr.at[idx].set(jnp.asarray(fill, arr.dtype))
+        if col == "_gen":
+            out[col] = arr.at[idx].add(jnp.asarray(1, arr.dtype))
+            continue
+        out[col] = arr.at[idx].set(
+            jnp.asarray(reserved_fill(col), arr.dtype))
     if init_state:
         for col, value in init_state.items():
             out[col] = out[col].at[idx].set(
@@ -316,11 +360,14 @@ def fault_restart_rows(state, ids, init_state=None):
 
 def fault_clear_failed(state, ids):
     """Clear only the failure flag (used by the 'stop' policy so a dead
-    row stops re-reporting)."""
+    row stops re-reporting). Also lowers `_escalated` — the host clearing
+    a lane IS the escalation's resolution."""
     import numpy as _np
     if "_failed" not in state:
         return state
     idx = jnp.asarray(_np.atleast_1d(_np.asarray(ids, _np.int32)))
     out = dict(state)
     out["_failed"] = out["_failed"].at[idx].set(False)
+    if "_escalated" in out:
+        out["_escalated"] = out["_escalated"].at[idx].set(False)
     return out

@@ -55,6 +55,14 @@ class FlightRecorder:
     def device_compile(self, system: str, elapsed_s: float) -> None: ...
     def dropped(self, system: str, count: int) -> None: ...
 
+    # in-graph supervision counter DELTA since the previous report
+    # (batched/supervision.py COUNTER_NAMES): one event per step window,
+    # emitted only when something happened — the watchdog's artifact shows
+    # directive traffic without per-step device syncs
+    def device_supervision(self, system: str, steps: int, failed: int,
+                           resumed: int, restarted: int, stopped: int,
+                           escalated: int, dead_letters: int) -> None: ...
+
     # -- generic escape hatch ------------------------------------------------
     def event(self, name: str, **fields: Any) -> None: ...
 
@@ -93,6 +101,9 @@ class InMemoryFlightRecorder(FlightRecorder):
         "device_flush": ("system", "staged"),
         "device_compile": ("system", "elapsed_s"),
         "dropped": ("system", "count"),
+        "device_supervision": ("system", "steps", "failed", "resumed",
+                               "restarted", "stopped", "escalated",
+                               "dead_letters"),
     }
 
     def __init__(self, capacity: int = 4096):

@@ -13,3 +13,5 @@ from .event_filter import LoggingTestKit  # noqa: F401
 from .sharding import region_entity_ids  # noqa: F401
 from .multi_node import (MultiNodeKit, NodeHandle, TestConductor,  # noqa: F401
                          BarrierTimeout)
+from .chaos import (chaos_hash, chaos_hit, chaos_hit_np,  # noqa: F401
+                    chaos_uniform_np, inject)

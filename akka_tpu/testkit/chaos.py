@@ -1,0 +1,211 @@
+"""Deterministic fault injection for the batched device runtime.
+
+Supervision code paths only fire when something breaks, and "something
+breaks" must be REPRODUCIBLE to be testable: the chaos decisions here are
+pure functions of (seed, step, lane) — no RNG state threads through the
+scan carry, no host randomness, and the SAME seed produces the SAME fault
+schedule on every delivery backend, every platform, and in a plain numpy
+loop. That last property is what the parity suite leans on
+(tests/test_supervision.py): an un-jitted oracle replays the exact fault
+schedule the jitted chaos behavior saw, so the supervision counters can be
+asserted EQUAL, not approximately equal.
+
+The primitive is an integer hash (murmur3 finalizer over the packed
+(seed, step, lane) words): `chaos_hash` is the jnp form used inside
+jitted behaviors, `chaos_hit`/`chaos_hit_np` the bit-identical
+jnp/numpy rate tests built on it (`chaos_uniform_np` maps the hash to
+[0, 1) for oracles that want a float). Fault kinds are composable masks
+over lanes:
+
+  crash_mask        lane raises `_failed` this step (let-it-crash input)
+  nan_mask          lane's state column is corrupted to NaN (pairs with
+                    BatchedBehavior.nonfinite_guard)
+  drop_mask         the lane's emissions this step are suppressed
+  dup_mask          the lane's slot-0 emission is duplicated into the
+                    last emit slot
+
+`inject(behavior, ...)` wraps a BatchedBehavior with any subset of these,
+returning a new behavior whose receive applies the faults AFTER the
+wrapped receive runs — the wrapped behavior never observes the chaos,
+exactly like a fault striking between two mailbox dequeues.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..batched.behavior import BatchedBehavior, Emit
+
+# murmur3 fmix32 constants — chosen for avalanche, not secrecy; any
+# fixed integer mixer with good bit diffusion works here
+_C1 = 0x85EBCA6B
+_C2 = 0xC2B2AE35
+_MASK32 = 0xFFFFFFFF
+
+
+def _fmix32_np(h) -> np.ndarray:
+    # all arithmetic in uint64 with explicit 32-bit masking: no reliance
+    # on numpy promotion rules (which changed across NEP 50) or on
+    # wraparound-overflow behavior for the multiplies
+    h = np.asarray(h, np.uint64) & np.uint64(_MASK32)
+    h = h ^ (h >> np.uint64(16))
+    h = (h * np.uint64(_C1)) & np.uint64(_MASK32)
+    h = h ^ (h >> np.uint64(13))
+    h = (h * np.uint64(_C2)) & np.uint64(_MASK32)
+    return h ^ (h >> np.uint64(16))
+
+
+def chaos_uniform_np(seed: int, step, lane, salt: int = 0) -> np.ndarray:
+    """numpy twin of chaos_uniform — bit-identical u32 hash, mapped to
+    [0, 1) as float64 (exact: 32-bit numerator, power-of-two divisor)."""
+    step = np.asarray(step, np.uint32)
+    lane = np.asarray(lane, np.uint32)
+    h = np.uint32(seed & _MASK32) ^ np.uint32((salt * 0x9E3779B9) & _MASK32)
+    h = _fmix32_np((h.astype(np.uint64) + step.astype(np.uint64)
+                    * np.uint64(0x85EBCA77)) & _MASK32)
+    h = _fmix32_np((h.astype(np.uint64) + lane.astype(np.uint64)
+                    * np.uint64(0xC2B2AE3D)) & _MASK32)
+    return h.astype(np.float64) / float(1 << 32)
+
+
+def chaos_hash(seed: int, step, lane, salt: int = 0):
+    """Deterministic per-(step, lane) u32 hash: pure integer arithmetic
+    in uint32 (bit-stable across backends/platforms — no float-order
+    sensitivity), finalized with the murmur3 mixer. `salt` decorrelates
+    independent fault kinds sharing one seed. Compare against
+    `_rate_threshold(rate)` rather than dividing: f32 rounding of h/2^32
+    is not bit-stable enough for a parity contract."""
+    def fmix(h):
+        h = h ^ (h >> 16)
+        h = h * jnp.uint32(_C1)
+        h = h ^ (h >> 13)
+        h = h * jnp.uint32(_C2)
+        return h ^ (h >> 16)
+
+    h = jnp.uint32(seed & _MASK32) ^ jnp.uint32((salt * 0x9E3779B9) & _MASK32)
+    h = fmix(h + jnp.asarray(step).astype(jnp.uint32) * jnp.uint32(0x85EBCA77))
+    h = fmix(h + jnp.asarray(lane).astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D))
+    return h
+
+
+def _rate_threshold(rate: float) -> int:
+    """rate in [0, 1] -> u32 threshold. Shared quantization for the jnp
+    and numpy sides: hash < threshold  <=>  uniform < rate."""
+    return min(int(round(rate * float(1 << 32))), 1 << 32)
+
+
+def chaos_hit(seed: int, step, lane, rate: float, salt: int = 0):
+    """jnp bool: does the (step, lane) cell fire at `rate`?"""
+    thr = _rate_threshold(rate)
+    if thr <= 0:
+        return jnp.zeros(jnp.shape(jnp.asarray(lane)), jnp.bool_) \
+            if jnp.ndim(jnp.asarray(lane)) else jnp.asarray(False)
+    if thr >= (1 << 32):
+        return jnp.ones(jnp.shape(jnp.asarray(lane)), jnp.bool_) \
+            if jnp.ndim(jnp.asarray(lane)) else jnp.asarray(True)
+    return chaos_hash(seed, step, lane, salt) < jnp.uint32(thr)
+
+
+def chaos_hit_np(seed: int, step, lane, rate: float, salt: int = 0):
+    """numpy twin of chaos_hit — the oracle's fault schedule."""
+    thr = _rate_threshold(rate)
+    if thr <= 0:
+        return np.zeros(np.shape(lane), np.bool_)
+    if thr >= (1 << 32):
+        return np.ones(np.shape(lane), np.bool_)
+    step = np.asarray(step, np.uint32)
+    lane = np.asarray(lane, np.uint32)
+    h = np.uint32(seed & _MASK32) ^ np.uint32((salt * 0x9E3779B9) & _MASK32)
+    h = _fmix32_np((h.astype(np.uint64) + step.astype(np.uint64)
+                    * np.uint64(0x85EBCA77)) & _MASK32)
+    h = _fmix32_np((h.astype(np.uint64) + lane.astype(np.uint64)
+                    * np.uint64(0xC2B2AE3D)) & _MASK32)
+    return h.astype(np.uint64) < np.uint64(thr)
+
+
+# salts decorrelating the four fault kinds (shared with oracles)
+CRASH_SALT, NAN_SALT, DROP_SALT, DUP_SALT = 1, 2, 3, 4
+
+
+def inject(target: BatchedBehavior, seed: int, crash_rate: float = 0.0,
+           nan_rate: float = 0.0, drop_rate: float = 0.0,
+           dup_rate: float = 0.0,
+           nan_col: Optional[str] = None) -> BatchedBehavior:
+    """Wrap a BatchedBehavior with deterministic fault injection.
+
+    Faults apply AFTER the wrapped receive, keyed on (seed, ctx.step,
+    ctx.actor_id) — reproducible, backend-independent, oracle-replayable:
+
+      crash_rate  raise `_failed` — the runtime treats it exactly like a
+                  poisoned receive (step.py per_actor): the lane's state
+                  update this step is DISCARDED, its emissions are
+                  suppressed, and the supervisor resolves the failure in
+                  the same jitted pass
+      nan_rate    overwrite `nan_col` (default: first inexact state
+                  column) with NaN — use with nonfinite_guard=True to
+                  exercise the guard, or without to watch NaN propagate
+      drop_rate   suppress ALL of the lane's emissions this step
+      dup_rate    copy the slot-0 emission into the LAST emit slot
+                  (duplicate delivery; needs out_degree >= 2 to differ)
+
+    The returned behavior shares the target's state spec (plus `_failed`
+    when crashes are injected) so it can replace the target 1:1.
+    """
+    if nan_rate > 0:
+        col = nan_col
+        if col is None:
+            for c, (_, dt) in target.state_spec.items():
+                if jnp.issubdtype(jnp.dtype(dt), jnp.inexact):
+                    col = c
+                    break
+        if col is None:
+            raise ValueError("nan_rate > 0 needs an inexact state column")
+        if col not in target.state_spec:
+            raise KeyError(f"unknown nan_col {col!r}")
+        nan_col = col
+
+    spec = dict(target.state_spec)
+    if crash_rate > 0:
+        spec.setdefault("_failed", ((), jnp.bool_))
+    inner = target.receive
+
+    def receive(state_row, delivered, ctx):
+        new_state, emit = inner(state_row, delivered, ctx)
+        lane = ctx.actor_id
+        if crash_rate > 0:
+            hit = chaos_hit(seed, ctx.step, lane, crash_rate, CRASH_SALT)
+            new_state = dict(new_state)
+            new_state["_failed"] = new_state.get(
+                "_failed", jnp.asarray(False)) | hit
+        if nan_rate > 0:
+            hit = chaos_hit(seed, ctx.step, lane, nan_rate, NAN_SALT)
+            new_state = dict(new_state)
+            v = jnp.asarray(new_state[nan_col])
+            new_state[nan_col] = jnp.where(hit, jnp.full_like(v, jnp.nan), v)
+        if drop_rate > 0 or dup_rate > 0:
+            emit = emit.with_type()
+            if dup_rate > 0:
+                hit = chaos_hit(seed, ctx.step, lane, dup_rate, DUP_SALT)
+                dup = hit & emit.valid[0]
+                emit = Emit(
+                    dst=emit.dst.at[-1].set(
+                        jnp.where(dup, emit.dst[0], emit.dst[-1])),
+                    payload=emit.payload.at[-1].set(
+                        jnp.where(dup, emit.payload[0], emit.payload[-1])),
+                    valid=emit.valid.at[-1].set(
+                        jnp.where(dup, True, emit.valid[-1])),
+                    type=emit.type.at[-1].set(
+                        jnp.where(dup, emit.type[0], emit.type[-1])))
+            if drop_rate > 0:
+                hit = chaos_hit(seed, ctx.step, lane, drop_rate, DROP_SALT)
+                emit = Emit(dst=jnp.where(hit, -1, emit.dst),
+                            payload=emit.payload,
+                            valid=emit.valid & ~hit,
+                            type=emit.type)
+        return new_state, emit
+
+    return dataclasses.replace(target, state_spec=spec, receive=receive)

@@ -167,8 +167,14 @@ def on_tpu_found(detail: str) -> None:
                          "ring-dynamic", "--trace", "traces/tpu_r05",
                          "--probe-timeout", "120"],
                timeout_s=1800)
+    # in-graph supervision on-chip: overhead row + the chaos run's
+    # directive counters (bench_supervision; the full surface carries it
+    # too, but a standalone artifact survives a budget-skipped full run)
+    run_logged("supervision", [sys.executable, "bench.py", "--config",
+                               "supervision", "--probe-timeout", "120"],
+               timeout_s=1800)
     paths = [LOG, "watchdog_bench_full.out", "watchdog_attrib.out",
-             "watchdog_trace.out"]
+             "watchdog_trace.out", "watchdog_supervision.out"]
     if last is not None:
         paths.append("BENCH_TPU.json")
     if os.path.isdir(os.path.join(REPO, "traces/tpu_r05")):
