@@ -20,5 +20,5 @@ from .bridge import (BatchedRuntimeHandle, DefaultCodec,  # noqa: F401
                      device_props, get_handle, reply_dst)
 from .core import BatchedSystem  # noqa: F401
 from .step import StepCore  # noqa: F401
-from .supervision import (COUNTER_NAMES, LaneSupervisor,  # noqa: F401
-                          SUP_COLUMNS)
+from .supervision import (ATT_WORDS, COUNTER_NAMES,  # noqa: F401
+                          LaneSupervisor, SUP_COLUMNS, decode_attention)

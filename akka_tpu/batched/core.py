@@ -48,25 +48,41 @@ import numpy as np
 
 from .behavior import BatchedBehavior
 from .step import StepCore
-from .supervision import (N_COUNTERS, SUP_COLUMNS, counts_dict,
-                          reserved_fill)
+from .supervision import (ATT_WORDS, N_COUNTERS, SUP_COLUMNS, counts_dict,
+                          decode_attention, reserved_fill)
 
 
 def drive_pipelined(step_once: Callable[[], None],
                     latest_handle: Callable[[], Any],
-                    n_steps: int, depth: int) -> None:
+                    n_steps: int, depth: int,
+                    on_drain: Optional[Callable[[np.ndarray], None]] = None,
+                    ) -> None:
     """Shared enqueue-ahead driver (BatchedSystem and ShardedBatchedSystem
     run_pipelined): dispatch up to `depth` single-step programs before
-    blocking on the oldest, keyed off each dispatch's step-count handle."""
+    blocking on the oldest, keyed off each dispatch's attention-word
+    handle. With `on_drain`, every retired program's word is fetched
+    (device_get — the sync) and handed to the callback, and the tail is
+    fully drained before returning so no word is skipped; without it the
+    tail stays in flight and the caller picks its own sync point."""
     if depth < 1:
         raise ValueError("depth must be >= 1")
     from collections import deque
-    inflight: deque = deque()  # step_count handles, oldest first
+    inflight: deque = deque()  # attention-word handles, oldest first
+
+    def drain_one() -> None:
+        h = inflight.popleft()
+        if on_drain is None:
+            jax.block_until_ready(h)
+        else:
+            on_drain(np.asarray(jax.device_get(h)))
+
     for _ in range(n_steps):
         step_once()
         inflight.append(latest_handle())
         while len(inflight) >= depth:
-            jax.block_until_ready(inflight.popleft())
+            drain_one()
+    while on_drain is not None and inflight:
+        drain_one()
 
 
 class BatchedSystem:
@@ -88,7 +104,8 @@ class BatchedSystem:
                  mailbox_slots: int = 0,
                  native_staging: Optional[bool] = None,
                  spill_capacity: Optional[int] = None,
-                 delivery_backend: Optional[str] = None):
+                 delivery_backend: Optional[str] = None,
+                 attention_latch_col: Optional[str] = None):
         if not behaviors:
             raise ValueError("at least one behavior required")
         self.capacity = int(capacity)
@@ -154,6 +171,11 @@ class BatchedSystem:
         # forced on the step path
         self.sup_counts = jnp.zeros((N_COUNTERS,), jnp.int32)
         self._sup_reported = np.zeros((N_COUNTERS,), np.int64)  # FR snapshot
+        # host-attention word (supervision.pack_attention): [ATT_WORDS]
+        # int32 summary emitted as an extra NON-donated output of every
+        # step — the depth-k pipelined drivers sync on THIS handle and
+        # read the flag bits instead of wide per-column device_gets
+        self.attention = jnp.zeros((ATT_WORDS,), jnp.int32)
 
         # inbox layout: [spill_cap | n*K emissions | host_inbox] — spill
         # first so redelivered (older) mail outranks fresh emissions in the
@@ -237,7 +259,8 @@ class BatchedSystem:
                               slots=self.mailbox_slots, need_max=need_max,
                               topology=topology, delivery=delivery,
                               spill_cap=self.spill_cap,
-                              delivery_backend=delivery_backend)
+                              delivery_backend=delivery_backend,
+                              attention_latch_col=attention_latch_col)
 
         # topology tables ride as runtime arguments (pytree): closure
         # constants would be baked into the HLO (multi-MB programs break
@@ -553,31 +576,46 @@ class BatchedSystem:
             new_inbox_type = new_inbox_type.at[:sc].set(sp_type)
             new_inbox_payload = new_inbox_payload.at[:sc].set(sp_pl)
             new_inbox_valid = new_inbox_valid.at[:sc].set(sp_v)
+        new_dropped = mail_dropped + dropped
+        new_counts = sup_counts + sup_delta
+        # the attention word is a pure function of the new carry, appended
+        # as an 11th output OUTSIDE the donation set (indices 0-8): its
+        # buffer is never aliased, so device_get on it is a safe sync
+        attention = self._core.attention_word(new_state, new_dropped,
+                                              new_counts, step_count + 1)
         return (new_state, behavior_id, alive, new_inbox_dst, new_inbox_type,
-                new_inbox_payload, new_inbox_valid, mail_dropped + dropped,
-                sup_counts + sup_delta, step_count + 1)
+                new_inbox_payload, new_inbox_valid, new_dropped,
+                new_counts, step_count + 1, attention)
 
     def _run_impl(self, state, behavior_id, alive, inbox_dst, inbox_type,
                   inbox_payload, inbox_valid, mail_dropped, sup_counts,
                   step_count, n_steps: int, topo_arrays=()):
         def body(carry, _):
-            return self._step_impl(*carry, topo_arrays), None
+            # drop the per-step attention word inside the scan: every field
+            # is carry-derived (flags = current state, counters cumulative),
+            # so recomputing it once from the final carry loses nothing
+            return self._step_impl(*carry, topo_arrays)[:10], None
 
         carry = (state, behavior_id, alive, inbox_dst, inbox_type,
                  inbox_payload, inbox_valid, mail_dropped, sup_counts,
                  step_count)
         carry, _ = jax.lax.scan(body, carry, None, length=n_steps)
-        return carry
+        attention = self._core.attention_word(carry[0], carry[7], carry[8],
+                                              carry[9])
+        return carry + (attention,)
 
     def _carry(self):
         return (self.state, self.behavior_id, self.alive, self.inbox_dst,
                 self.inbox_type, self.inbox_payload, self.inbox_valid,
                 self.mail_dropped, self.sup_counts, self.step_count)
 
-    def _set_carry(self, carry) -> None:
+    def _set_carry(self, out) -> None:
+        # `out` is a step/run output: the 10 carry slots plus the
+        # non-donated attention word
         (self.state, self.behavior_id, self.alive, self.inbox_dst,
          self.inbox_type, self.inbox_payload, self.inbox_valid,
-         self.mail_dropped, self.sup_counts, self.step_count) = carry
+         self.mail_dropped, self.sup_counts, self.step_count,
+         self.attention) = out
 
     def step(self) -> None:
         """One delivery+update step. Staged host tells ride INSIDE the same
@@ -620,7 +658,9 @@ class BatchedSystem:
             fr.device_step("batched", n_steps, _time.perf_counter() - t0)
             self._report_supervision(fr)
 
-    def run_pipelined(self, n_steps: int, depth: int = 2) -> None:
+    def run_pipelined(self, n_steps: int, depth: int = 2,
+                      on_attention: Optional[Callable[[Dict[str, Any]],
+                                                      None]] = None) -> None:
         """n SEPARATE single-step dispatches with up to `depth` programs in
         flight: step k+1 is enqueued before step k completes, hiding host
         program-launch latency (on a tunneled backend: tunnel RTT) behind
@@ -632,9 +672,18 @@ class BatchedSystem:
         Unlike run(), host tells staged BETWEEN dispatches ride in the
         next step (run() fuses the whole window into one program that
         flushes once) — this is the latency-oriented driver, run() the
-        throughput-oriented one."""
-        drive_pipelined(lambda: self.step(), lambda: self.step_count,
-                        n_steps, depth)
+        throughput-oriented one.
+
+        The pipeline keys off each step's host-attention word (not
+        step_count): with `on_attention`, every retired step's decoded
+        word (supervision.decode_attention) is delivered in order and the
+        tail is fully drained before returning — the narrow-readback hook
+        the bridge pump builds on."""
+        cb = None
+        if on_attention is not None:
+            cb = lambda w: on_attention(decode_attention(w))  # noqa: E731
+        drive_pipelined(lambda: self.step(), lambda: self.attention,
+                        n_steps, depth, on_drain=cb)
 
     def warmup(self) -> None:
         """Execute the step AND the flush once on throwaway zero-filled
@@ -674,6 +723,12 @@ class BatchedSystem:
         # sync via a host read of a non-donated output: on some platforms
         # donated/aliased buffers report ready before the program finishes
         np.asarray(jax.device_get(self.step_count))
+
+    def read_attention(self) -> Dict[str, int]:
+        """Decode the newest host-attention word — one tiny device_get
+        that (like block_until_ready) also syncs the newest dispatched
+        step, since the word is a non-donated output of that program."""
+        return decode_attention(self.attention)
 
     # -------------------------------------------------------- fault handling
     def any_failed(self) -> bool:

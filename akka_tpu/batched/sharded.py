@@ -50,8 +50,8 @@ from ..ops.segment import exchange_uses_ranked, stable_ranks
 from ..parallel.mesh import make_mesh
 from .behavior import BatchedBehavior
 from .step import StepCore
-from .supervision import (N_COUNTERS, SUP_COLUMNS, counts_dict,
-                          reserved_fill)
+from .supervision import (ATT_WORDS, N_COUNTERS, SUP_COLUMNS, counts_dict,
+                          decode_attention, reserved_fill)
 
 
 class ShardedBatchedSystem:
@@ -171,6 +171,12 @@ class ShardedBatchedSystem:
         # COUNTER_NAMES order) — summed over shards on host read
         self.sup_counts = jax.device_put(
             jnp.zeros((self.n_shards, N_COUNTERS), jnp.int32), shard)
+        # host-attention word (supervision.pack_attention): replicated
+        # [ATT_WORDS] summary recomputed from the final carry of every
+        # run() — the pipelined driver syncs on this handle instead of
+        # step_count and reads the flag bits with ONE tiny device_get
+        self.attention = jax.device_put(
+            jnp.zeros((ATT_WORDS,), jnp.int32), NamedSharding(self.mesh, P()))
 
         self._next_row = 0
         self._lock = threading.Lock()
@@ -353,7 +359,13 @@ class ShardedBatchedSystem:
                      inbox_payload, inbox_valid, dropped, mail_dropped,
                      sup_counts, step_count)
             carry, _ = jax.lax.scan(body, carry, None, length=n_steps)
-            return carry
+            # host-attention word from the final carry: every field is
+            # carry-derived (flags = current state, counters cumulative),
+            # so one cross-shard reduction per run() covers the window —
+            # nothing rides the scan. Appended OUTSIDE the donation set.
+            attention = core.attention_word(carry[0], carry[8], carry[9],
+                                            carry[10])
+            return carry + (attention,)
 
         # pin output shardings to the INPUT shardings: without this, GSPMD
         # may normalize an output (observed: inbox_payload -> replicated on
@@ -363,7 +375,7 @@ class ShardedBatchedSystem:
         repl_s = NamedSharding(mesh, P())
         out_shardings = ({k: shard_s for k in self.state_spec},
                          shard_s, shard_s, shard_s, shard_s, shard_s,
-                         shard_s, shard_s, shard_s, shard_s, repl_s)
+                         shard_s, shard_s, shard_s, shard_s, repl_s, repl_s)
         return jax.jit(multi_step, static_argnums=(12,),
                        donate_argnums=tuple(range(10)),
                        out_shardings=out_shardings)
@@ -527,7 +539,8 @@ class ShardedBatchedSystem:
         self._flush_staged()
         (self.state, self.behavior_id, self.alive, self.inbox_dst,
          self.inbox_type, self.inbox_payload, self.inbox_valid, self.dropped,
-         self.mail_dropped, self.sup_counts, self.step_count) = \
+         self.mail_dropped, self.sup_counts, self.step_count,
+         self.attention) = \
             self._step_fn(self.state, self.behavior_id, self.alive,
                           self.inbox_dst, self.inbox_type, self.inbox_payload,
                           self.inbox_valid, self.dropped, self.mail_dropped,
@@ -536,13 +549,25 @@ class ShardedBatchedSystem:
 
     step = run
 
-    def run_pipelined(self, n_steps: int, depth: int = 2) -> None:
+    def run_pipelined(self, n_steps: int, depth: int = 2,
+                      on_attention=None) -> None:
         """Single-step dispatches with up to `depth` in flight (see
         BatchedSystem.run_pipelined): hides host/tunnel launch latency
-        behind the mesh step; donated carries make the overlap free."""
+        behind the mesh step; donated carries make the overlap free.
+        Syncs on the host-attention word; with `on_attention`, every
+        retired step's decoded word is delivered in order and the tail is
+        fully drained (the narrow-readback drain the bridge pump uses)."""
         from .core import drive_pipelined
-        drive_pipelined(lambda: self.run(1), lambda: self.step_count,
-                        n_steps, depth)
+        cb = None
+        if on_attention is not None:
+            cb = lambda w: on_attention(decode_attention(w))  # noqa: E731
+        drive_pipelined(lambda: self.run(1), lambda: self.attention,
+                        n_steps, depth, on_drain=cb)
+
+    def read_attention(self) -> Dict[str, int]:
+        """Decode the newest host-attention word — one tiny device_get
+        that also syncs the newest dispatched run (non-donated output)."""
+        return decode_attention(self.attention)
 
     def read_state(self, col: str, ids: Optional[np.ndarray] = None) -> np.ndarray:
         arr = self.state[col]

@@ -30,7 +30,7 @@ from ..ops.segment import (Delivery, SlotDelivery, deliver, deliver_slots,
                            deliver_static)
 from .behavior import BatchedBehavior, Ctx, Emit, Inbox, Mailbox, _bshape
 from .supervision import (N_COUNTERS, SupervisionTables, apply_supervision,
-                          reserved_fill)
+                          pack_attention, reserved_fill)
 
 
 class StepCore:
@@ -47,7 +47,8 @@ class StepCore:
                  slots: int = 0, need_max: bool = False, topology=None,
                  delivery: str = "auto", n_global: Optional[int] = None,
                  spill_cap: int = 0,
-                 delivery_backend: Optional[str] = None):
+                 delivery_backend: Optional[str] = None,
+                 attention_latch_col: Optional[str] = None):
         self.behaviors = list(behaviors)
         self.n_local = int(n_local)
         self.n_global = int(n_global if n_global is not None else n_local)
@@ -65,6 +66,10 @@ class StepCore:
         # spill region size (slots mode): overflow + suspended-row mail is
         # retained there instead of dropped (unbounded-mailbox semantics)
         self.spill_cap = int(spill_cap)
+        # state column whose any() feeds ATT_LATCH_BIT of the host-attention
+        # word (the bridge passes its promise-replied column; None = no
+        # latch bit in the word)
+        self.attention_latch_col = attention_latch_col
 
         if self.slots == 0:
             bad = [b.name for b in self.behaviors if b.inbox == "slots"]
@@ -268,6 +273,18 @@ class StepCore:
                 old_failed=state["_failed"], delivered_count=d.count,
                 step=step_count)
         return new_state, new_behavior_id, new_alive, emits, sup_delta
+
+    def attention_word(self, state, mail_dropped, sup_counts, step_count):
+        """[ATT_WORDS] int32 host-attention word for the step that produced
+        these carries (supervision.pack_attention over this core's latch
+        column). Emitted as a NON-donated output of the jitted step so a
+        `device_get` on it doubles as the pipeline sync for the program —
+        the depth-k pump reads this instead of `block_until_ready` plus
+        wide per-column fetches. Accepts scalar or per-shard blocks for
+        mail_dropped / sup_counts (shard_map callers pass their local
+        blocks and reshape the result to [1, ATT_WORDS])."""
+        return pack_attention(state, mail_dropped, sup_counts, step_count,
+                              latch_col=self.attention_latch_col)
 
     def run_local(self, state, behavior_id, alive, inbox_dst, inbox_type,
                   inbox_payload, inbox_valid, step_count, topo_arrays=(),

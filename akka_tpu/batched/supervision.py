@@ -278,3 +278,72 @@ def counts_dict(vec) -> Dict[str, int]:
     import numpy as np
     arr = np.asarray(jax.device_get(vec)).reshape(-1, N_COUNTERS).sum(0)
     return {name: int(arr[i]) for i, name in enumerate(COUNTER_NAMES)}
+
+
+# --------------------------------------------------------------------------
+# Host-attention word
+#
+# The depth-k bridge pump (batched/bridge.py) and the pipelined drivers
+# drain their in-flight programs by fetching ONE tiny int32 vector per
+# round instead of `block_until_ready` plus separate wide device_gets of
+# `_failed`, `_escalated` and the promise-latch column. The word is a
+# NON-donated output of the jitted step, so `device_get` on its handle
+# doubles as the sync point for that step's whole program.
+
+ATT_WORDS = 4
+ATT_FLAGS, ATT_DROPPED, ATT_DEAD_LETTERS, ATT_STEP = range(ATT_WORDS)
+
+# ATT_FLAGS bit layout
+ATT_FAILED_BIT = 1     # some lane holds `_failed` (feeds _handle_failures)
+ATT_ESCALATED_BIT = 2  # some lane holds `_escalated` (host must resolve)
+ATT_LATCH_BIT = 4      # some promise row latched a reply (bridge asks)
+
+
+def attention_flags(state: Dict[str, jax.Array],
+                    latch_col: Optional[str] = None) -> jax.Array:
+    """[()] int32 flag word over the state columns (traced in-graph).
+    Absent columns contribute a trace-time zero — unsupervised systems
+    pay nothing for the bits they can never raise."""
+    i32 = jnp.int32
+    flags = jnp.asarray(0, i32)
+    if "_failed" in state:
+        flags = flags | jnp.any(state["_failed"]).astype(i32) * ATT_FAILED_BIT
+    if "_escalated" in state:
+        flags = flags | (jnp.any(state["_escalated"]).astype(i32)
+                         * ATT_ESCALATED_BIT)
+    if latch_col is not None and latch_col in state:
+        flags = flags | (jnp.any(state[latch_col] != 0).astype(i32)
+                         * ATT_LATCH_BIT)
+    return flags
+
+
+def pack_attention(state: Dict[str, jax.Array], mail_dropped, sup_counts,
+                   step_count, latch_col: Optional[str] = None) -> jax.Array:
+    """[ATT_WORDS] int32 attention word for one step (traced in-graph).
+    `mail_dropped` / `sup_counts` may be scalars or per-shard blocks —
+    both reduce to totals here, so single-device and shard_map callers
+    share the packing."""
+    i32 = jnp.int32
+    dropped = jnp.sum(jnp.asarray(mail_dropped)).astype(i32)
+    dead = jnp.reshape(jnp.asarray(sup_counts),
+                       (-1, N_COUNTERS))[:, DEAD_LETTERS].sum().astype(i32)
+    return jnp.stack([attention_flags(state, latch_col), dropped, dead,
+                      jnp.asarray(step_count).astype(i32)])
+
+
+def decode_attention(word) -> Dict[str, Any]:
+    """Host-side decode of attention word(s): [ATT_WORDS] or, sharded,
+    [n_shards, ATT_WORDS]. Flags OR across shards, counters sum, step
+    takes the max (it is replicated, so any shard's value is the step)."""
+    import numpy as np
+    a = np.asarray(jax.device_get(word), np.int64).reshape(-1, ATT_WORDS)
+    flags = int(np.bitwise_or.reduce(a[:, ATT_FLAGS])) if a.size else 0
+    return {
+        "flags": flags,
+        "any_failed": bool(flags & ATT_FAILED_BIT),
+        "any_escalated": bool(flags & ATT_ESCALATED_BIT),
+        "any_latched": bool(flags & ATT_LATCH_BIT),
+        "mail_dropped": int(a[:, ATT_DROPPED].sum()),
+        "dead_letters": int(a[:, ATT_DEAD_LETTERS].sum()),
+        "step": int(a[:, ATT_STEP].max()) if a.size else 0,
+    }

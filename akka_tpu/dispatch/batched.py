@@ -55,6 +55,8 @@ class TpuBatchedDispatcher(Dispatcher):
                     event_stream=getattr(system, "event_stream", None),
                     flight_recorder=getattr(system, "flight_recorder", None),
                     failure_policy=c.get_string("failure-policy", "restart"),
+                    pipeline_depth=overrides.get(
+                        "pipeline_depth", c.get_int("pipeline-depth", 2)),
                 )
             return self._handle
 

@@ -63,6 +63,15 @@ class FlightRecorder:
                            resumed: int, restarted: int, stopped: int,
                            escalated: int, dead_letters: int) -> None: ...
 
+    # depth-k dispatch pipeline counter DELTA since the previous report
+    # (batched/bridge.py): programs enqueued/drained in the window and how
+    # many drains paid the wide promise readback (wide_resolves) vs
+    # host-only deadline checks — emitted at the pump's busy->idle edge
+    # and at handle shutdown
+    def device_pipeline(self, system: str, depth: int, steps: int,
+                        drains: int, wide_resolves: int,
+                        host_checks: int) -> None: ...
+
     # -- generic escape hatch ------------------------------------------------
     def event(self, name: str, **fields: Any) -> None: ...
 
@@ -104,6 +113,8 @@ class InMemoryFlightRecorder(FlightRecorder):
         "device_supervision": ("system", "steps", "failed", "resumed",
                                "restarted", "stopped", "escalated",
                                "dead_letters"),
+        "device_pipeline": ("system", "depth", "steps", "drains",
+                            "wide_resolves", "host_checks"),
     }
 
     def __init__(self, capacity: int = 4096):

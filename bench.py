@@ -292,6 +292,133 @@ def bench_latency(rounds):
     return out
 
 
+def bench_bridge_latency(rounds, depth=4):
+    """Config: the bridge's per-round dispatch cost, old synchronous pump
+    vs the depth-k attention-word pump (batched/bridge.py). The `sync`
+    rows time the pre-pipeline round verbatim — `rt.step();
+    rt.block_until_ready(); _resolve_waiters()` with an outstanding ask,
+    so every round pays the full-block sync plus the wide promise-block
+    readback. The `pipelined` rows time the replacement — enqueue + one
+    [ATT_WORDS] attention fetch, wide readback only on a raised latch
+    bit. dispatch_speedup_p50 is the ratio: the host-side ask-path cost
+    the attention word removes. Public-API ask p50/p99 (through the pump
+    thread, so including wake handoffs) and the handle's pipeline_stats
+    ride along in the artifact."""
+    from collections import deque as _deque
+    from concurrent.futures import Future as _Future
+
+    import numpy as np
+
+    from akka_tpu.batched import Emit, behavior
+    from akka_tpu.batched.bridge import BatchedRuntimeHandle, reply_dst
+
+    @behavior("blat-echo", {})
+    def blat_echo(state, inbox, ctx):
+        return state, Emit.single(reply_dst(inbox.sum), inbox.sum * 2, 1, 8,
+                                  when=inbox.count > 0)
+
+    def pcts(xs):
+        xs = sorted(xs)
+        p = lambda q: xs[min(int(q * len(xs)), len(xs) - 1)]
+        return {"p50_us": round(p(0.50) * 1e6, 1),
+                "p99_us": round(p(0.99) * 1e6, 1)}
+
+    h = BatchedRuntimeHandle(capacity=256, payload_width=8, promise_rows=32,
+                             host_inbox=256, pipeline_depth=depth)
+    try:
+        row = int(h.spawn(blat_echo, 1)[0])
+        # warm PUMP-FREE (only tell/ask start the pump thread; a live pump
+        # would free-run on the synthetic waiter below and contend on the
+        # step lock during the timed rounds): the fused flush+step program
+        # via a staged tell + step, then the plain step program
+        h._ensure_runtime()
+        h._stage_tell(row, np.zeros(8, np.float32), 0, None)
+        h.step(2)
+        h.runtime.block_until_ready()
+
+        # a never-resolving waiter (long deadline, no pump wake) keeps the
+        # old-pump emulation honest: with a waiter outstanding its
+        # _resolve_waiters pays the wide readback EVERY round, exactly
+        # like the pre-pipeline pump servicing an in-flight ask
+        with h._lock:
+            slot = h._promise_free.pop()
+            prow = h._promise_base + slot
+        h._clear_latches([slot])  # a stale latch would resolve it instantly
+        with h._lock:
+            h._waiters[prow] = (_Future(), h.default_codec)
+            h._waiter_deadlines[prow] = (time.monotonic() + 3600.0, 3600.0)
+
+        def old_round():
+            with h._step_lock:
+                h._runtime.step()
+            h._runtime.block_until_ready()
+            h._resolve_waiters()
+
+        dq = _deque()
+
+        def new_round():
+            h._enqueue_step(dq)
+            h._drain_one(dq)
+
+        def time_rounds(fn):
+            fn()
+            fn()  # warm the exact per-round pattern
+            ts = []
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                fn()
+                ts.append(time.perf_counter() - t0)
+            return ts
+
+        old_ts = time_rounds(old_round)
+        new_ts = time_rounds(new_round)
+
+        n_steps = max(64, rounds)
+
+        def best_rate(window):
+            best = 0.0
+            for _ in range(3):
+                t0 = time.perf_counter()
+                window(n_steps)
+                best = max(best, n_steps / (time.perf_counter() - t0))
+            return best
+
+        def sync_window(k):
+            for _ in range(k):
+                old_round()
+
+        sync_rate = best_rate(sync_window)
+        pipe_rate = best_rate(lambda k: h.step(k, depth=depth))
+
+        with h._lock:  # retire the synthetic waiter
+            h._waiters.pop(prow, None)
+            h._waiter_deadlines.pop(prow, None)
+            h._promise_free.append(slot)
+
+        # public ask path LAST — the first ask starts the pump thread
+        h.ask_sync(row, (0, [1.0]), timeout=30.0)  # warm pump + wake path
+        asks = []
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            h.ask_sync(row, (0, [1.0]), timeout=30.0)
+            asks.append(time.perf_counter() - t0)
+        stats = h.pipeline_stats()
+    finally:
+        h.shutdown()
+
+    out = {"rounds": rounds, "depth": depth,
+           "sync": {"dispatch": pcts(old_ts),
+                    "steps_per_sec": round(sync_rate, 1)},
+           "pipelined": {"dispatch": pcts(new_ts),
+                         "steps_per_sec": round(pipe_rate, 1),
+                         "ask": pcts(asks), "pipeline": stats}}
+    out["dispatch_speedup_p50"] = round(
+        out["sync"]["dispatch"]["p50_us"]
+        / max(out["pipelined"]["dispatch"]["p50_us"], 0.1), 2)
+    out["overlap_speedup"] = round(pipe_rate / sync_rate, 2)
+    return out
+
+
 def bench_spawn(n_device_rows, n_host_actors):
     """--config-only extra mirroring ActorCreationBenchmark /
     RouterPoolCreationBenchmark (akka-bench-jmh/.../actor/): device-row
@@ -498,7 +625,8 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--config", choices=["ring", "ring-dynamic", "fan-in",
                                          "router", "router-api", "shard",
-                                         "shard-api", "latency", "modes",
+                                         "shard-api", "latency",
+                                         "bridge-latency", "modes",
                                          "supervision", "spawn", "stream"],
                     help="run a single config (spawn/stream are extra "
                          "JMH-analogue microbenches outside the default "
@@ -589,6 +717,16 @@ def main() -> None:
                       f"correct={'OK' if r['ok'] else 'FAIL'}",
                       file=sys.stderr)
             return None
+        if name == "bridge-latency":
+            extra["bridge"] = out
+            print(f"[bench] bridge-latency: dispatch p50 "
+                  f"sync={out['sync']['dispatch']['p50_us']}us -> "
+                  f"depth{out['depth']}="
+                  f"{out['pipelined']['dispatch']['p50_us']}us "
+                  f"(x{out['dispatch_speedup_p50']}) "
+                  f"ask p50={out['pipelined']['ask']['p50_us']}us "
+                  f"overlap x{out['overlap_speedup']}", file=sys.stderr)
+            return None
         if name == "supervision":
             extra["supervision"] = out
             print(f"[bench] supervision: overhead={out['overhead_pct']}% "
@@ -615,6 +753,7 @@ def main() -> None:
         "shard": lambda: bench_cross_shard(*shard_counts, steps),
         "shard-api": lambda: bench_shard_api(*shard_counts, steps),
         "latency": lambda: bench_latency(lat_rounds),
+        "bridge-latency": lambda: bench_bridge_latency(lat_rounds),
         "modes": lambda: bench_modes(n, mode_steps),
         "supervision": lambda: bench_supervision(n, mode_steps),
     }
@@ -627,6 +766,8 @@ def main() -> None:
         "router-api": "actor.tell() throughput, RoundRobinPool 100k routees (routing API)",
         "shard": "actor.tell() throughput, 256x4k cross-shard",
         "shard-api": "actor.tell() throughput, 256x4k cross-shard (sharding API)",
+        "bridge-latency": "bridge pump dispatch round, depth-k attention "
+                          "drain (p50)",
     }
     if args.config:
         # single-config path honors the same contract as the full surface:
@@ -659,6 +800,13 @@ def main() -> None:
                     "value": out["device_elems_per_sec"],
                     "unit": "elems/sec", "vs_baseline": 1.0,
                     "extra": {"stream": out, **extra}}))
+            elif args.config == "bridge-latency":
+                out = bench_bridge_latency(lat_rounds)
+                print(json.dumps({
+                    "metric": metric_names["bridge-latency"] + scale_tag,
+                    "value": out["pipelined"]["dispatch"]["p50_us"],
+                    "unit": "us", "vs_baseline": out["dispatch_speedup_p50"],
+                    "extra": {"bridge": out, **extra}}))
             elif args.config == "supervision":
                 out = bench_supervision(n, mode_steps)
                 print(json.dumps({
@@ -712,7 +860,8 @@ def main() -> None:
         })
 
     for name in ("ring", "ring-dynamic", "modes", "supervision", "latency",
-                 "fan-in", "router", "router-api", "shard", "shard-api"):
+                 "bridge-latency", "fan-in", "router", "router-api", "shard",
+                 "shard-api"):
         elapsed = time.perf_counter() - t_start
         if elapsed > args.budget:
             extra[name] = {"skipped": f"budget ({args.budget:.0f}s) "

@@ -75,3 +75,21 @@ def test_modes_smoke_ranked_beats_reference():
             <= 1.5 * out["slots_reference"]["ms_per_step"])
     recv_ok = [out[k]["ok"] for k in out if "msgs_per_sec" in out[k]]
     assert all(recv_ok)
+
+
+def test_bridge_pipeline_throughput_budget():
+    """ISSUE 3 satellite: the depth-k attention-word pump must never be
+    SLOWER than the synchronous pump round it replaced (step +
+    block_until_ready + unconditional wide promise readback). The bench
+    times both against the same handle with an unresolved waiter
+    outstanding, so the sync leg pays the wide readback every round
+    exactly like the pre-pipeline pump servicing an in-flight ask; the
+    pipelined leg drains one [ATT_WORDS] word instead. >= rather than a
+    ratio: the margin is ~2x on CPU but the contract is only "the
+    pipeline is free", and best-of-3 windows keep scheduler noise out."""
+    out = bench.bench_bridge_latency(20, depth=4)
+    assert out["pipelined"]["steps_per_sec"] >= out["sync"]["steps_per_sec"], out
+    # pipeline depth is recorded in the artifact (watchdog parses it)
+    assert out["depth"] == 4
+    assert out["pipelined"]["pipeline"]["depth"] == 4
+    assert out["pipelined"]["pipeline"]["steps"] > 0

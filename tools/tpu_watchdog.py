@@ -173,8 +173,37 @@ def on_tpu_found(detail: str) -> None:
     run_logged("supervision", [sys.executable, "bench.py", "--config",
                                "supervision", "--probe-timeout", "120"],
                timeout_s=1800)
+    # bridge dispatch pipeline on-chip: old synchronous pump round vs the
+    # depth-k attention-word drain; pipeline depth + drain counters land
+    # in the watchdog log next to the device_supervision rows
+    run_logged("bridge", [sys.executable, "bench.py", "--config",
+                          "bridge-latency", "--probe-timeout", "120"],
+               timeout_s=1800)
+    bridge_out = os.path.join(REPO, "watchdog_bridge.out")
+    if os.path.exists(bridge_out):
+        bj = None
+        for line in open(bridge_out):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    bj = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
+        pipe = (bj or {}).get("extra", {}).get("bridge", {})
+        stats = pipe.get("pipelined", {}).get("pipeline", {})
+        if stats:
+            append_log({"ts": _utcnow(), "ok": True,
+                        "detail": "bridge pipeline stats",
+                        "pipeline_depth": stats.get("depth"),
+                        "steps": stats.get("steps"),
+                        "drains": stats.get("drains"),
+                        "wide_resolves": stats.get("wide_resolves"),
+                        "host_checks": stats.get("host_checks"),
+                        "dispatch_speedup_p50":
+                            pipe.get("dispatch_speedup_p50")})
     paths = [LOG, "watchdog_bench_full.out", "watchdog_attrib.out",
-             "watchdog_trace.out", "watchdog_supervision.out"]
+             "watchdog_trace.out", "watchdog_supervision.out",
+             "watchdog_bridge.out"]
     if last is not None:
         paths.append("BENCH_TPU.json")
     if os.path.isdir(os.path.join(REPO, "traces/tpu_r05")):
