@@ -207,6 +207,14 @@ class BatchedSystem:
         # optional FlightRecorder (event/flight_recorder.py SPI): step/flush
         # events for post-mortem traces; None = zero overhead
         self.flight_recorder = None
+        # host mirror of the dispatched-step counter: incremented when a
+        # step is DISPATCHED (device step_count lags until sync). The WAL
+        # tags each staged batch with this counter — a batch staged at c is
+        # flushed by dispatch c+1, which is what replay reproduces.
+        self._host_step = 0
+        # optional write-ahead journal (persistence/tell_journal.py):
+        # tell/seed_inbox append the staged batch BEFORE enqueue
+        self.tell_journal = None
         # native staging buffer: producers memcpy rows into a preallocated
         # C++ buffer with one atomic reserve, the flush drains a contiguous
         # block (SURVEY.md §2.10 item 5 — envelope-pool parity). Rows carry
@@ -412,6 +420,11 @@ class BatchedSystem:
             pl = np.pad(pl, [(0, 0)] * (pl.ndim - 1) + [(0, pad)])
         mt = np.broadcast_to(np.atleast_1d(np.asarray(mtype, np.int32)),
                              (dst_arr.shape[0],))
+        if self.tell_journal is not None:
+            # WAL: journal the normalized, generation-filtered batch BEFORE
+            # it reaches any staging buffer — recovery re-stages exactly
+            # this batch at this step counter, no expect_gen re-check
+            self.tell_journal.append(self._host_step, "tell", dst_arr, pl, mt)
         if self._stager is not None:
             if self.mailbox_slots > 0:
                 rows = np.empty((dst_arr.shape[0], self.payload_width + 1),
@@ -444,6 +457,13 @@ class BatchedSystem:
         """Bulk device-side injection: overwrite the first len(dst) inbox slots
         (the fast path for benches / bulk tells — the equivalent of the
         reference bench pre-filling mailboxes, TellOnlyBenchmark.scala:19-92)."""
+        if self.tell_journal is not None:
+            # seeds write device slots directly, so a seed record at the
+            # snapshot's own step may already be IN the snapshot — replay
+            # overwrites the same slots with the same values (idempotent)
+            self.tell_journal.append(self._host_step, "seed",
+                                     np.asarray(dst), np.asarray(payload),
+                                     np.asarray(mtype))
         dst = jnp.asarray(dst, jnp.int32)
         payload = jnp.asarray(payload, self.payload_dtype)
         if payload.ndim == 1:
@@ -635,6 +655,7 @@ class BatchedSystem:
             else:
                 self._set_carry(self._step_jit(*self._carry(),
                                                self._topo_arrays))
+        self._host_step += 1
         fr = self.flight_recorder
         if fr is not None:
             # elapsed_s is DISPATCH time (launch is async; the device may
@@ -653,6 +674,7 @@ class BatchedSystem:
         with trace_span(f"akka.device.run[{n_steps}]"):
             self._set_carry(self._run_jit(*self._carry(), n_steps,
                                           self._topo_arrays))
+        self._host_step += int(n_steps)
         fr = self.flight_recorder
         if fr is not None:
             fr.device_step("batched", n_steps, _time.perf_counter() - t0)
@@ -730,6 +752,48 @@ class BatchedSystem:
         step, since the word is a non-donated output of that program."""
         return decode_attention(self.attention)
 
+    # ------------------------------------------------- checkpoint / recovery
+    def checkpoint(self, directory: str, keep: Optional[int] = None) -> str:
+        """Checkpoint barrier: drain every in-flight dispatch to a
+        quiescent point (a host read of the non-donated step_count — the
+        pipeline's safe sync handle), then snapshot the complete schema-v2
+        slab pytree (state columns incl. supervision slabs, inbox tensors,
+        aggregate counters, attention word). With a write-ahead tell
+        journal attached, the journal is compacted to records at/after the
+        snapshot step; `keep` bounds retained snapshots (oldest GC'd).
+        Returns the snapshot path."""
+        from ..persistence.slab_snapshot import gc_slabs, save_slabs
+        self.block_until_ready()
+        path = save_slabs(self, directory)
+        if self.tell_journal is not None:
+            self.tell_journal.compact(self._host_step)
+        if keep is not None:
+            gc_slabs(directory, keep)
+        return path
+
+    def restore(self, path: str, journal=None) -> int:
+        """Crash recovery: load a snapshot (schema v1 or v2) into this
+        system and reset the host step counter from its step_count. The
+        caller builds a same-config system and re-runs its spawns first —
+        behaviors are code, not snapshot data, so host allocation state
+        (row free-list, generations) is rebuilt by the spawn replay, then
+        the device slabs are overwritten here. Host staging buffers are
+        discarded: anything staged-but-unflushed at the crash replays from
+        the journal, never from stale buffers. With `journal` set,
+        journaled batches past the snapshot step are replayed to the crash
+        frontier. Returns the restored host step counter."""
+        from ..persistence.slab_snapshot import restore_slabs
+        from ..persistence.tell_journal import replay_journal
+        restore_slabs(self, path)
+        self._host_step = int(np.asarray(jax.device_get(self.step_count)))
+        if self._stager is not None:
+            self._stager.drain()
+        with self._lock:
+            self._host_staged = []
+        if journal is not None:
+            replay_journal(self, journal)
+        return self._host_step
+
     # -------------------------------------------------------- fault handling
     def any_failed(self) -> bool:
         """One device scalar — the pump's cheap per-tick check."""
@@ -738,8 +802,14 @@ class BatchedSystem:
 
     def failed_rows(self) -> np.ndarray:
         """Rows whose behavior raised the `_failed` flag (error lanes —
-        suspended until restarted; FaultHandling.scala parity)."""
+        suspended until restarted; FaultHandling.scala parity).
+
+        Implicitly drains the dispatch pipeline first: with run_pipelined
+        steps in flight, the state slabs are donated/aliased buffers that
+        some platforms report ready early — host reads must sync on the
+        non-donated step_count before touching them."""
         from .step import fault_failed_rows
+        self.block_until_ready()
         return fault_failed_rows(self.state)
 
     def restart_rows(self, ids,
@@ -816,6 +886,10 @@ class BatchedSystem:
 
     # ------------------------------------------------------------------ read
     def read_state(self, col: str, ids: Optional[np.ndarray] = None) -> np.ndarray:
+        """Host copy of one state column. Implicitly drains the dispatch
+        pipeline first (see failed_rows): a read during a full
+        run_pipelined window must not observe donated buffers."""
+        self.block_until_ready()
         arr = self.state[col]
         if ids is not None:
             arr = arr[jnp.asarray(ids)]

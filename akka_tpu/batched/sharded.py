@@ -181,6 +181,10 @@ class ShardedBatchedSystem:
         self._next_row = 0
         self._lock = threading.Lock()
         self._host_staged: List[Tuple[int, int, np.ndarray]] = []
+        # host mirror of the dispatched-step counter + optional write-ahead
+        # tell journal (persistence/tell_journal.py) — see BatchedSystem
+        self._host_step = 0
+        self.tell_journal = None
         # small replicated lookup tables exposed to behaviors via
         # ctx.tables (e.g. device-sharding placement). Set BEFORE first
         # run; keys are fixed per built step function.
@@ -402,6 +406,11 @@ class ShardedBatchedSystem:
         pl = np.zeros(self.payload_width, dtype=jnp.dtype(self.payload_dtype))
         arr = np.asarray(payload).reshape(-1)
         pl[: arr.shape[0]] = arr
+        if self.tell_journal is not None:
+            # WAL: journal the normalized row BEFORE staging (see
+            # BatchedSystem.tell)
+            self.tell_journal.append(self._host_step, "tell",
+                                     int(dst), pl, int(mtype))
         with self._lock:
             self._host_staged.append((int(dst), int(mtype), pl))
 
@@ -546,6 +555,7 @@ class ShardedBatchedSystem:
                           self.inbox_valid, self.dropped, self.mail_dropped,
                           self.sup_counts, self.step_count, self.tables,
                           n_steps)
+        self._host_step += int(n_steps)
 
     step = run
 
@@ -570,6 +580,12 @@ class ShardedBatchedSystem:
         return decode_attention(self.attention)
 
     def read_state(self, col: str, ids: Optional[np.ndarray] = None) -> np.ndarray:
+        """Host copy of one state column. Implicitly drains the dispatch
+        pipeline first: with run_pipelined steps in flight the slabs are
+        donated/aliased buffers that some platforms report ready early, so
+        host reads sync on the non-donated step_count before touching
+        them."""
+        self.block_until_ready()
         arr = self.state[col]
         if ids is not None:
             arr = arr[jnp.asarray(ids)]
@@ -580,8 +596,10 @@ class ShardedBatchedSystem:
         return fault_any_failed(self.state)
 
     def failed_rows(self) -> np.ndarray:
-        """Rows whose behavior raised the `_failed` error lane."""
+        """Rows whose behavior raised the `_failed` error lane.
+        Drains the dispatch pipeline first (see read_state)."""
         from .step import fault_failed_rows
+        self.block_until_ready()
         return fault_failed_rows(self.state)
 
     def restart_rows(self, ids,
@@ -631,3 +649,140 @@ class ShardedBatchedSystem:
     def block_until_ready(self) -> None:
         # sync via host read of a non-donated output (see core.py note)
         np.asarray(jax.device_get(self.step_count))
+
+    # ------------------------------------------------- checkpoint / recovery
+    def checkpoint(self, directory: str, keep: Optional[int] = None) -> str:
+        """Checkpoint barrier (see BatchedSystem.checkpoint): quiesce on
+        the non-donated step_count, snapshot the schema-v2 slab pytree
+        (slab_snapshot host-gathers the mesh-sharded slabs), compact the
+        attached tell journal, GC retained snapshots."""
+        from ..persistence.slab_snapshot import gc_slabs, save_slabs
+        self.block_until_ready()
+        path = save_slabs(self, directory)
+        if self.tell_journal is not None:
+            self.tell_journal.compact(self._host_step)
+        if keep is not None:
+            gc_slabs(directory, keep)
+        return path
+
+    def restore(self, path: str, journal=None) -> int:
+        """Crash recovery, including after a preemption that changed the
+        device count: when the snapshot's shard layout matches this mesh
+        the slabs restore in place; otherwise they are RE-SHARDED — row
+        slabs re-placed under this mesh's sharding, per-shard counters
+        conserved into shard 0, and in-flight inbox rows re-placed by
+        destination shard in their original delivery order. The caller
+        builds a same-capacity system and re-runs its spawns first (see
+        BatchedSystem.restore). With `journal` set, journaled batches past
+        the snapshot step replay to the crash frontier."""
+        from ..persistence.slab_snapshot import (load_slab_tree,
+                                                 restore_slab_pytree)
+        from ..persistence.tell_journal import replay_journal
+        tree = load_slab_tree(path)
+        snap_rows = int(np.asarray(tree["behavior_id"]).shape[0])
+        if snap_rows != self.capacity:
+            raise ValueError(f"snapshot capacity {snap_rows} != "
+                             f"system capacity {self.capacity}")
+        if tuple(np.asarray(tree["inbox_dst"]).shape) == \
+                tuple(self.inbox_dst.shape):
+            restore_slab_pytree(self, tree)
+        else:
+            self._restore_resharded(tree)
+        self._host_step = int(np.asarray(jax.device_get(self.step_count)))
+        with self._lock:
+            self._host_staged = []
+        if journal is not None:
+            replay_journal(self, journal)
+        return self._host_step
+
+    def _restore_resharded(self, tree: Dict[str, Any]) -> None:
+        """Re-shard a snapshot taken on a different device count onto this
+        mesh. Row-indexed slabs ([capacity] and [capacity, ...]) are layout
+        independent — fresh device_puts under this mesh's sharding place
+        them. Per-shard aggregates ([old_n_shards]) are conserved by
+        summing into shard 0 (only totals are ever read). In-flight inbox
+        rows are gathered on the host and re-placed into each destination
+        shard's block starting at the exchange region, preserving global
+        order — the stable (recipient, slot) delivery sort then delivers
+        them in the original order on the first restored step."""
+        from ..persistence.slab_snapshot import SCHEMA_VERSION
+        version = int(np.asarray(tree.get("schema_version", 1)))
+        if version > SCHEMA_VERSION:
+            raise ValueError(
+                f"snapshot schema v{version} is newer than this runtime's "
+                f"v{SCHEMA_VERSION}; upgrade the runtime to restore it")
+        shard = NamedSharding(self.mesh, P(self.axis))
+        repl = NamedSharding(self.mesh, P())
+        for col, arr in tree["state"].items():
+            cur = self.state.get(col)
+            if cur is None:
+                continue
+            if tuple(cur.shape) != tuple(np.asarray(arr).shape):
+                raise ValueError(
+                    f"slab shape mismatch for state[{col!r}]: "
+                    f"{np.asarray(arr).shape} vs {tuple(cur.shape)}")
+            self.state[col] = jax.device_put(jnp.asarray(arr), shard)
+        for col, cur in list(self.state.items()):
+            if col not in tree["state"]:
+                # v1 upgrade: absent columns reset to their re-arm fill
+                self.state[col] = jax.device_put(
+                    jnp.full(cur.shape, reserved_fill(col), cur.dtype),
+                    shard)
+        self.behavior_id = jax.device_put(
+            jnp.asarray(tree["behavior_id"], jnp.int32), shard)
+        self.alive = jax.device_put(
+            jnp.asarray(tree["alive"], jnp.bool_), shard)
+        self.step_count = jax.device_put(
+            jnp.asarray(np.asarray(tree["step_count"]).max(), jnp.int32),
+            repl)
+        att = tree.get("attention")
+        self.attention = jax.device_put(
+            jnp.asarray(att, jnp.int32) if att is not None
+            else jnp.zeros((ATT_WORDS,), jnp.int32), repl)
+        ns = self.n_shards
+        dropped = np.zeros((ns,), np.int32)
+        dropped[0] = int(np.asarray(tree.get("dropped", 0)).sum())
+        self.dropped = jax.device_put(jnp.asarray(dropped), shard)
+        md = np.zeros((ns,), np.int32)
+        md[0] = int(np.asarray(tree.get("mail_dropped", 0)).sum())
+        self.mail_dropped = jax.device_put(jnp.asarray(md), shard)
+        sc = np.zeros((ns, N_COUNTERS), np.int32)
+        if "sup_counts" in tree:
+            sc[0] = np.asarray(tree["sup_counts"]).reshape(
+                -1, N_COUNTERS).sum(axis=0)
+        self.sup_counts = jax.device_put(jnp.asarray(sc), shard)
+        # in-flight mail: gather valid rows, re-place by destination shard
+        dst = np.asarray(tree["inbox_dst"])
+        typ = np.asarray(tree["inbox_type"])
+        pl = np.asarray(tree["inbox_payload"])
+        val = np.asarray(tree["inbox_valid"]).astype(bool)
+        if pl.shape[1] != self.payload_width:
+            raise ValueError(f"snapshot payload width {pl.shape[1]} != "
+                             f"system payload width {self.payload_width}")
+        m_global = self.m_local * ns
+        np_dtype = np.dtype(jnp.dtype(self.payload_dtype))
+        new_dst = np.full((m_global,), -1, np.int32)
+        new_typ = np.zeros((m_global,), np.int32)
+        new_pl = np.zeros((m_global, self.payload_width), np_dtype)
+        new_val = np.zeros((m_global,), np.bool_)
+        region = self.m_local - self.spill_cap
+        used = np.zeros((ns,), np.int64)
+        for i in np.nonzero(val)[0]:
+            d = int(dst[i])
+            s = max(0, min(d, self.capacity - 1)) // self.local_n
+            u = int(used[s])
+            if u >= region:
+                raise RuntimeError(
+                    f"in-flight mail for shard {s} ({u + 1} rows) exceeds "
+                    f"its inbox block on the {ns}-shard mesh")
+            slot = s * self.m_local + self.spill_cap + u
+            new_dst[slot] = d
+            new_typ[slot] = int(typ[i])
+            new_pl[slot] = pl[i]
+            new_val[slot] = True
+            used[s] += 1
+        self.inbox_dst = jax.device_put(jnp.asarray(new_dst), shard)
+        self.inbox_type = jax.device_put(jnp.asarray(new_typ), shard)
+        self.inbox_payload = jax.device_put(
+            jnp.asarray(new_pl, self.payload_dtype), shard)
+        self.inbox_valid = jax.device_put(jnp.asarray(new_val), shard)

@@ -177,6 +177,13 @@ def reference_config() -> Config:
                     "mailbox-slots": 0,     # >0 = per-message ordered mailboxes
                     "promise-rows": 256,    # ask() promise slots
                     "auto-step-interval": "1ms",
+                    "pipeline-depth": 2,    # in-flight programs for step(depth=)
+                    # preemption tolerance: snapshot every N dispatched steps
+                    # into checkpoint-dir, retaining checkpoint-keep newest
+                    # (0 / "" disables; see docs/CHECKPOINT_RECOVERY.md)
+                    "checkpoint-interval-steps": 0,
+                    "checkpoint-dir": "",
+                    "checkpoint-keep": 3,
                     "mesh-axes": {},
                 },
                 "default-mailbox": {

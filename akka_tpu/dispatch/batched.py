@@ -57,6 +57,14 @@ class TpuBatchedDispatcher(Dispatcher):
                     failure_policy=c.get_string("failure-policy", "restart"),
                     pipeline_depth=overrides.get(
                         "pipeline_depth", c.get_int("pipeline-depth", 2)),
+                    checkpoint_interval_steps=overrides.get(
+                        "checkpoint_interval_steps",
+                        c.get_int("checkpoint-interval-steps", 0)),
+                    checkpoint_dir=overrides.get(
+                        "checkpoint_dir",
+                        c.get_string("checkpoint-dir", "") or None),
+                    checkpoint_keep=overrides.get(
+                        "checkpoint_keep", c.get_int("checkpoint-keep", 3)),
                 )
             return self._handle
 

@@ -72,6 +72,18 @@ class FlightRecorder:
                         drains: int, wide_resolves: int,
                         host_checks: int) -> None: ...
 
+    # checkpoint/recovery (batched runtime + persistence/tell_journal):
+    # one device_checkpoint per snapshot taken; checkpoint_failed when
+    # snapshot IO degrades (the step loop keeps running); journal_truncated
+    # when a torn record-log tail is repaired on open
+    def device_checkpoint(self, system: str, step: int, elapsed_s: float,
+                          size_bytes: int, path: str) -> None: ...
+
+    def checkpoint_failed(self, system: str, error: str,
+                          consecutive: int) -> None: ...
+
+    def journal_truncated(self, path: str, dropped_bytes: int) -> None: ...
+
     # -- generic escape hatch ------------------------------------------------
     def event(self, name: str, **fields: Any) -> None: ...
 
@@ -115,6 +127,10 @@ class InMemoryFlightRecorder(FlightRecorder):
                                "dead_letters"),
         "device_pipeline": ("system", "depth", "steps", "drains",
                             "wide_resolves", "host_checks"),
+        "device_checkpoint": ("system", "step", "elapsed_s", "size_bytes",
+                              "path"),
+        "checkpoint_failed": ("system", "error", "consecutive"),
+        "journal_truncated": ("path", "dropped_bytes"),
     }
 
     def __init__(self, capacity: int = 4096):

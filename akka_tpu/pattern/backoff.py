@@ -19,6 +19,18 @@ from ..actor.ref import ActorRef
 from ..actor.supervision import OneForOneStrategy, Stop, default_decider
 
 
+def backoff_delay(restart_count: int, min_backoff: float, max_backoff: float,
+                  random_factor: float = 0.0) -> float:
+    """Exponential backoff delay (BackoffSupervisor.scala calculateDelay):
+    min_backoff * 2^restart_count capped at max_backoff, plus optional
+    random jitter. Shared by BackoffSupervisor and the batched runtime's
+    checkpoint-failure pacing (random_factor=0 there: deterministic)."""
+    delay = min(min_backoff * (2 ** restart_count), max_backoff)
+    if random_factor:
+        delay *= 1.0 + random.random() * random_factor
+    return delay
+
+
 class GetCurrentChild:
     pass
 
@@ -87,8 +99,8 @@ class BackoffSupervisor(Actor):
         if isinstance(message, Terminated) and self.child is not None \
                 and message.actor == self.child:
             self.child = None
-            delay = min(self.min_backoff * (2 ** self.restart_count), self.max_backoff)
-            delay *= 1.0 + random.random() * self.random_factor
+            delay = backoff_delay(self.restart_count, self.min_backoff,
+                                  self.max_backoff, self.random_factor)
             self.restart_count += 1
             self.context.system.scheduler.schedule_tell_once(
                 delay, self.self_ref, _StartChild(), self.self_ref)

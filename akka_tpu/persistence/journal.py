@@ -198,11 +198,72 @@ class _SerializedPayload:
         self.serializer_id, self.manifest, self.data = s
 
 
+def scan_record_log(path: str):
+    """Yield (end_offset, record) for every INTACT record in a
+    length-prefixed record log, stopping at the first torn or corrupt tail
+    (short header, short blob, or a blob pickle.loads rejects). The
+    end_offset of the last yielded record is the byte length of the valid
+    prefix — what repair_record_log truncates to."""
+    if not os.path.exists(path):
+        return
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        offset = 0
+        while True:
+            hdr = f.read(8)
+            if len(hdr) < 8:
+                return
+            n = int.from_bytes(hdr, "little")
+            if offset + 8 + n > size:
+                # truncated tail, OR garbage bytes read as an absurd length
+                # prefix — bound by the file size BEFORE allocating, so a
+                # torn tail can never MemoryError the repair that exists
+                # to clean it up
+                return
+            blob = f.read(n)
+            if len(blob) < n:
+                return  # truncated tail (crash mid-append)
+            try:
+                obj = pickle.loads(blob)
+            except Exception:  # noqa: BLE001 — torn/garbled tail record
+                return
+            offset += 8 + n
+            yield offset, obj
+
+
+def repair_record_log(path: str, flight_recorder=None) -> int:
+    """Crash-safe open: truncate a torn tail record (a host killed
+    mid-append leaves a partial length-prefix+blob) back to the last intact
+    record, warning via the flight recorder instead of letting readers hit
+    UnpicklingError. Returns the number of bytes dropped (0 = intact)."""
+    if not os.path.exists(path):
+        return 0
+    good = 0
+    for end, _obj in scan_record_log(path):
+        good = end
+    size = os.path.getsize(path)
+    if size <= good:
+        return 0
+    with open(path, "r+b") as f:
+        f.truncate(good)
+        f.flush()
+        os.fsync(f.fileno())
+    dropped = size - good
+    if flight_recorder is not None and getattr(
+            flight_recorder, "enabled", False):
+        flight_recorder.journal_truncated(path, dropped)
+    return dropped
+
+
 class FileJournal(JournalPlugin):
     """Append-only record log: one file per persistence id, length-prefixed
     pickled PersistentReprs, plus a tag-index file. Replaces the reference's
     LevelDB store (journal/leveldb/LeveldbStore.scala) with the same
     capabilities: per-id replay, highest-seq-nr, logical delete-to, tags.
+
+    Appends are atomic-at-the-record (length-prefix + fsync); on open every
+    log in the directory is repaired via repair_record_log, so a kill -9
+    mid-append costs at most the record being written, never the log.
 
     With `serialization` set (a serialization.Serialization), event
     PAYLOADS are stored as (serializer id, manifest, bytes) envelopes via
@@ -210,8 +271,10 @@ class FileJournal(JournalPlugin):
     makes journals survive schema evolution (VersionedJsonSerializer +
     SchemaMigration, the Jackson-journal analogue)."""
 
-    def __init__(self, directory: str, serialization=None):
+    def __init__(self, directory: str, serialization=None,
+                 flight_recorder=None):
         self.serialization = serialization
+        self.flight_recorder = flight_recorder
         self.dir = directory
         os.makedirs(directory, exist_ok=True)
         self.lock = threading.RLock()
@@ -221,6 +284,10 @@ class FileJournal(JournalPlugin):
         # {pid: {"deleted_to": n, "highest": n}}, global tag offset counter
         self._meta: Dict[str, Dict[str, int]] = {}
         self._offset = 0
+        for name in sorted(os.listdir(directory)):
+            if name.endswith(".log"):
+                repair_record_log(os.path.join(directory, name),
+                                  flight_recorder)
         self._load_meta()
 
     # -- file helpers ---------------------------------------------------------
@@ -240,18 +307,10 @@ class FileJournal(JournalPlugin):
 
     @staticmethod
     def _read_records(path: str):
-        if not os.path.exists(path):
-            return
-        with open(path, "rb") as f:
-            while True:
-                hdr = f.read(8)
-                if len(hdr) < 8:
-                    return
-                n = int.from_bytes(hdr, "little")
-                blob = f.read(n)
-                if len(blob) < n:
-                    return  # truncated tail (crash mid-append): ignore
-                yield pickle.loads(blob)
+        # torn/corrupt tails stop the scan rather than raising; the repair
+        # pass in __init__ already truncated them with a warning
+        for _end, obj in scan_record_log(path):
+            yield obj
 
     def _load_meta(self) -> None:
         if os.path.exists(self._meta_path):

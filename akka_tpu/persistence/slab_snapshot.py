@@ -4,39 +4,69 @@ SURVEY.md §2.10 item 8 / §5 checkpoint-resume: "snapshot = dump of SoA state
 tensors (orbax), journal = append-only host log of message batches; replay =
 re-running jitted steps". This module is that snapshot half for
 akka_tpu.batched.BatchedSystem: every device-resident slab (per-column actor
-state, behavior ids, alive mask, inbox tensors, step counter) is serialized
-as one pytree.
+state, behavior ids, alive mask, inbox tensors, step counter, supervision
+counters, attention word) is serialized as one pytree.
+
+Schema v2 (docs/CHECKPOINT_RECOVERY.md has the full layout): v1 carried only
+the seven core slabs and silently dropped the supervision aggregates added
+since — a restore of a v1 snapshot into a supervised system would resume
+with whatever stale counters the target happened to hold. v2 adds
+`mail_dropped`, `sup_counts`, `attention` and the sharded `dropped` block
+plus an explicit `schema_version` field; the loader still accepts v1
+snapshots and ZERO-FILLS (with `reserved_fill`) every live slab the snapshot
+does not carry, so the restored state is a pure function of the snapshot
+file, never of the pre-restore target.
 
 Uses orbax-checkpoint when importable (async-friendly, TPU-native sharding
 aware) and falls back to a .npz file — the pytree layout is identical, so
-the two formats are feature-equivalent for single-host slabs.
+the two formats are feature-equivalent for single-host slabs. The .npz
+fallback writes tmp + fsync + os.replace, so a crash mid-save leaves the
+previous snapshot intact instead of a torn file.
 
 Journal-side replay integration: JournalPlugin stores inbox batches via
 `record_step_batch`, and `replay_steps` re-applies them to a restored system
 — the reference's event replay (persistence/Eventsourced.scala recovery)
-with "event" = one step's message batch.
+with "event" = one step's message batch. The write-ahead tell journal
+(persistence/tell_journal.py) is the crash-recovery counterpart: staged
+batches are logged BEFORE enqueue and replayed past the snapshot's step.
 """
 
 from __future__ import annotations
 
 import os
+import shutil
 from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+SCHEMA_VERSION = 2
 
-_SLAB_KEYS = ("behavior_id", "alive", "step_count", "inbox_dst",
-              "inbox_type", "inbox_payload", "inbox_valid")
+# v1 slabs: core actor/inbox tensors (pre-supervision snapshots carry only
+# these).
+_SLAB_KEYS_V1 = ("behavior_id", "alive", "step_count", "inbox_dst",
+                 "inbox_type", "inbox_payload", "inbox_valid")
+# v2 additions: supervision aggregates + the attention word. `dropped`
+# exists only on ShardedBatchedSystem; getattr-None skips it elsewhere.
+_SLAB_KEYS_V2 = ("mail_dropped", "sup_counts", "attention", "dropped")
+_SLAB_KEYS = _SLAB_KEYS_V1 + _SLAB_KEYS_V2
+
+
+def _reserved_fill(col: str) -> int:
+    from ..batched.supervision import reserved_fill
+    return reserved_fill(col)
 
 
 def slab_pytree(system) -> Dict[str, Any]:
     """Extract the full device state of a BatchedSystem (or
     ShardedBatchedSystem) as a pytree of HOST copies. Copies are mandatory:
     the step functions donate their input buffers, so a snapshot of live
-    device arrays would be deleted by the very next `run()`."""
+    device arrays would be deleted by the very next `run()`. Callers must
+    quiesce first (`block_until_ready()`); the system-level `checkpoint()`
+    entry points do."""
     tree: Dict[str, Any] = {
+        "schema_version": np.int64(SCHEMA_VERSION),
         "state": {k: np.asarray(jax.device_get(v))
                   for k, v in system.state.items()}}
     for k in _SLAB_KEYS:
@@ -61,26 +91,51 @@ def _put_like(system, arr, current) -> Any:
 
 def restore_slab_pytree(system, tree: Dict[str, Any]) -> None:
     """Load a pytree produced by slab_pytree back into `system` (shapes must
-    match: same capacity/out_degree/payload schema)."""
+    match: same capacity/out_degree/payload schema).
+
+    Version handling: snapshots without `schema_version` are v1. Any live
+    state column or v2 slab the snapshot lacks is reset to its
+    `reserved_fill` value — a v1 snapshot restored into a supervised system
+    yields zeroed retry counters / re-armed backoff deadlines, not the
+    target's stale pre-restore values. Snapshot columns the target does not
+    declare are skipped (a behavior-schema change is the caller's problem,
+    not a KeyError)."""
+    version = int(np.asarray(tree.get("schema_version", 1)))
+    if version > SCHEMA_VERSION:
+        raise ValueError(
+            f"snapshot schema v{version} is newer than this runtime's "
+            f"v{SCHEMA_VERSION}; upgrade the runtime to restore it")
     for col, arr in tree["state"].items():
         cur = system.state.get(col)
-        if cur is not None and tuple(cur.shape) != tuple(arr.shape):
+        if cur is None:
+            continue  # column no longer in the target's schema
+        if tuple(cur.shape) != tuple(arr.shape):
             raise ValueError(
                 f"slab shape mismatch for state[{col!r}]: "
                 f"{tuple(arr.shape)} vs {tuple(cur.shape)}")
         system.state[col] = _put_like(system, arr, cur)
+    for col, cur in list(system.state.items()):
+        if col not in tree["state"]:
+            # v1 upgrade path: supervision columns absent from the
+            # snapshot reset to their re-arm fill, for determinism
+            fill = jnp.full(cur.shape, _reserved_fill(col), cur.dtype)
+            system.state[col] = _put_like(system, fill, cur)
     for k in _SLAB_KEYS:
-        if k not in tree:
-            continue  # older snapshot without this column
         cur = getattr(system, k, None)
-        arr = tree[k]
         if cur is None:
-            continue
-        if hasattr(cur, "shape") and tuple(cur.shape) != tuple(
-                np.asarray(arr).shape):
-            raise ValueError(f"slab shape mismatch for {k}: "
-                             f"{np.asarray(arr).shape} vs {tuple(cur.shape)}")
-        setattr(system, k, _put_like(system, arr, cur))
+            continue  # slab the target does not have (e.g. `dropped`)
+        if k in tree:
+            arr = tree[k]
+            if hasattr(cur, "shape") and tuple(cur.shape) != tuple(
+                    np.asarray(arr).shape):
+                raise ValueError(
+                    f"slab shape mismatch for {k}: "
+                    f"{np.asarray(arr).shape} vs {tuple(cur.shape)}")
+            setattr(system, k, _put_like(system, arr, cur))
+        elif k in _SLAB_KEYS_V2:
+            # v1 snapshot: the aggregate never existed — zero it
+            fill = jnp.zeros(cur.shape, cur.dtype)
+            setattr(system, k, _put_like(system, fill, cur))
 
 
 def _try_orbax():
@@ -102,17 +157,27 @@ def save_slabs(system, directory: str, step: Optional[int] = None) -> str:
         ckpt.save(path, tree, force=True)
         return path
     os.makedirs(directory, exist_ok=True)
-    flat = {}
+    flat = {"schema_version": tree["schema_version"]}
     for col, arr in tree["state"].items():
         flat[f"state.{col}"] = arr
     for k in _SLAB_KEYS:
-        flat[k] = tree[k]
-    np.savez(path + ".npz", **flat)
-    return path + ".npz"
+        if k in tree:
+            flat[k] = tree[k]
+    # tmp + fsync + rename: a crash mid-save must not tear the snapshot a
+    # recovery is about to depend on
+    final = path + ".npz"
+    tmp = final + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **flat)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, final)
+    return final
 
 
-def restore_slabs(system, path: str) -> None:
-    """Restore a snapshot written by save_slabs into `system`."""
+def load_slab_tree(path: str) -> Dict[str, Any]:
+    """Read a snapshot back as the host-side pytree (no system needed) —
+    the re-sharding restore path inspects shapes before placement."""
     if path.endswith(".npz"):
         with np.load(path) as data:
             tree: Dict[str, Any] = {"state": {}}
@@ -121,13 +186,27 @@ def restore_slabs(system, path: str) -> None:
                     tree["state"][k[len("state."):]] = data[k]
                 else:
                     tree[k] = data[k]
-        restore_slab_pytree(system, tree)
-        return
+        return tree
     ocp = _try_orbax()
     if ocp is None:
         raise RuntimeError("orbax not available and path is not .npz")
-    tree = ocp.PyTreeCheckpointer().restore(path)
-    restore_slab_pytree(system, tree)
+    return ocp.PyTreeCheckpointer().restore(path)
+
+
+def restore_slabs(system, path: str) -> None:
+    """Restore a snapshot written by save_slabs into `system`."""
+    restore_slab_pytree(system, load_slab_tree(path))
+
+
+def _slab_step(name: str) -> Optional[int]:
+    if not name.startswith("slab-"):
+        return None
+    stem = name[len("slab-"):]
+    stem = stem[:-4] if stem.endswith(".npz") else stem
+    try:
+        return int(stem)
+    except ValueError:
+        return None
 
 
 def latest_slab_path(directory: str) -> Optional[str]:
@@ -135,14 +214,33 @@ def latest_slab_path(directory: str) -> Optional[str]:
         return None
     best, best_step = None, -1
     for name in os.listdir(directory):
-        if not name.startswith("slab-"):
-            continue
-        stem = name[len("slab-"):]
-        stem = stem[:-4] if stem.endswith(".npz") else stem
-        try:
-            step = int(stem)
-        except ValueError:
-            continue
-        if step > best_step:
+        step = _slab_step(name)
+        if step is not None and step > best_step:
             best, best_step = os.path.join(directory, name), step
     return best
+
+
+def gc_slabs(directory: str, keep: int) -> int:
+    """Retained-snapshot GC: delete all but the `keep` newest snapshots in
+    `directory`. Returns how many were removed. Both the .npz fallback
+    (files) and orbax (directories) layouts are handled."""
+    if keep <= 0 or not os.path.isdir(directory):
+        return 0
+    entries = []
+    for name in os.listdir(directory):
+        step = _slab_step(name)
+        if step is not None:
+            entries.append((step, name))
+    entries.sort(reverse=True)
+    removed = 0
+    for _step, name in entries[keep:]:
+        full = os.path.join(directory, name)
+        try:
+            if os.path.isdir(full):
+                shutil.rmtree(full)
+            else:
+                os.remove(full)
+            removed += 1
+        except OSError:
+            pass  # concurrent GC / permissions: stale snapshot stays
+    return removed

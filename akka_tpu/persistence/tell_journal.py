@@ -1,0 +1,141 @@
+"""Write-ahead tell journal for the batched runtime (ISSUE 4 tentpole #2).
+
+SURVEY.md §5: "journal = append-only host log of message batches; replay =
+re-running jitted steps". Every host-staged batch (`tell` / `seed_inbox`) is
+appended to an fsync'd, length-prefixed record log BEFORE it is enqueued
+toward the device, tagged with the host-side dispatched-step counter at
+staging time. Recovery = load the latest slab snapshot (step S), then replay
+journal records with step >= S: each record is re-staged once the replaying
+system has been stepped to the record's counter, so the batch is flushed
+into the same step that delivered it originally. Pure steps between records
+are simply re-run — the jitted step function is deterministic, so the
+replayed run is bit-identical to the crashed one up to the crash frontier.
+
+Why `step >= S` is exactly right: staging and stepping serialize on the
+system lock, and a batch staged while the counter reads c is flushed by
+dispatch c+1. A snapshot at quiescent step S therefore reflects every batch
+with c <= S-1 and none with c >= S; replaying the latter (and only the
+latter) reconstructs the host staging buffers as they were. `seed_inbox`
+writes device slots directly, so a seed record at exactly step S may already
+be visible in the snapshot — replaying it overwrites the same slots with
+the same values, an idempotent no-op.
+
+Torn tails (kill -9 mid-append) are truncated on open via
+journal.repair_record_log with a flight-recorder warning, mirroring the
+FileJournal record log this format extends.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+from .journal import repair_record_log, scan_record_log
+
+KIND_TELL = "tell"
+KIND_SEED = "seed"
+
+
+class TellJournal:
+    """Append-only WAL of staged tell batches, one file.
+
+    Records are dicts {step, kind, dst, mtype, payload} with numpy payloads
+    (host copies — the journal must not pin device buffers). Appends are
+    atomic-at-the-record: 8-byte little-endian length prefix + pickle +
+    flush + fsync, the FileJournal record idiom.
+    """
+
+    def __init__(self, path: str, flight_recorder: Optional[Any] = None):
+        self.path = path
+        self.flight_recorder = flight_recorder
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self.truncated_bytes = repair_record_log(path, flight_recorder)
+        self._lock = threading.Lock()
+        self._fh = open(path, "ab")
+
+    # -- write side ----------------------------------------------------------
+    def append(self, step: int, kind: str, dst, payload, mtype) -> None:
+        rec: Dict[str, Any] = {
+            "step": int(step),
+            "kind": kind,
+            "dst": np.ascontiguousarray(np.asarray(dst)),
+            "mtype": np.ascontiguousarray(np.asarray(mtype)),
+            "payload": np.ascontiguousarray(np.asarray(payload)),
+        }
+        blob = pickle.dumps(rec, protocol=4)
+        with self._lock:
+            if self._fh is None:
+                raise ValueError("TellJournal is closed")
+            self._fh.write(len(blob).to_bytes(8, "little"))
+            self._fh.write(blob)
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+
+    # -- read side -----------------------------------------------------------
+    def records(self) -> Iterator[Dict[str, Any]]:
+        """Iterate intact records oldest-first (reads the file; safe while
+        the append handle is open — appends are flushed per-record)."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+        for _end, obj in scan_record_log(self.path):
+            yield obj
+
+    # -- maintenance ---------------------------------------------------------
+    def compact(self, before_step: int) -> int:
+        """Drop records with step < before_step (already covered by a
+        snapshot at that step). Rewrites atomically: tmp + fsync + replace,
+        then reopens the append handle. Returns records retained."""
+        kept = [rec for rec in self.records()
+                if int(rec["step"]) >= int(before_step)]
+        tmp = self.path + ".tmp"
+        with self._lock:
+            with open(tmp, "wb") as f:
+                for rec in kept:
+                    blob = pickle.dumps(rec, protocol=4)
+                    f.write(len(blob).to_bytes(8, "little"))
+                    f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            if self._fh is not None:
+                self._fh.close()
+            os.replace(tmp, self.path)
+            self._fh = open(self.path, "ab")
+        return len(kept)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+def replay_journal(system, journal: TellJournal) -> int:
+    """Replay journaled batches recorded at/after the system's restored
+    step counter, stepping the system forward so each batch is staged at
+    the same counter it was staged at originally. Re-journaling is
+    suspended for the duration (the records already exist). Returns the
+    final host step counter — the crash frontier's last fully-dispatched
+    step; batches staged but not yet flushed at the crash are left staged,
+    exactly as they were."""
+    start = system._host_step
+    saved, system.tell_journal = system.tell_journal, None
+    try:
+        for rec in journal.records():
+            step = int(rec["step"])
+            if step < start:
+                continue
+            while system._host_step < step:
+                system.step()
+            if rec["kind"] == KIND_SEED:
+                system.seed_inbox(rec["dst"], rec["payload"], rec["mtype"])
+            else:
+                system.tell(rec["dst"], rec["payload"], rec["mtype"])
+    finally:
+        system.tell_journal = saved
+    return system._host_step

@@ -616,6 +616,76 @@ def bench_supervision(n, steps):
     }
 
 
+def bench_checkpoint(n, interval=256, windows=3, directory=None):
+    """Checkpoint-overhead row (docs/CHECKPOINT_RECOVERY.md): the SAME
+    dynamic ring driven as per-dispatch steps, bare vs with a barrier
+    snapshot every `interval` steps — prices the quiescence drain plus the
+    slab dump amortized over the interval (budgeted <= 5% at interval 256,
+    tests/test_bench_smoke.py). Per-dispatch stepping is the honest
+    denominator: a fused run(interval) would be one dispatch and make the
+    snapshot look 50x more expensive than it is under the pump, which
+    dispatches step-at-a-time. Quiet path: no tells in the windows, so the
+    write-ahead journal adds zero fsyncs — this row prices cadence alone."""
+    import shutil
+    import tempfile
+    from akka_tpu.batched import BatchedSystem
+    from akka_tpu.models.baseline_benches import (PAYLOAD_W, ring_behavior,
+                                                  seed_ring_full)
+
+    d = directory or tempfile.mkdtemp(prefix="bench-ckpt-")
+    s = BatchedSystem(capacity=n, behaviors=[ring_behavior],
+                      payload_width=PAYLOAD_W, host_inbox=8)
+    s.spawn_block(0, n)
+    seed_ring_full(s)
+    for _ in range(4):
+        s.step()
+    s.block_until_ready()
+    # warm the snapshot path too: orbax/np bring-up on the FIRST save is
+    # tens of ms of one-time cost that the cadence never pays again
+    s.checkpoint(d, keep=2)
+
+    def window(with_ckpt):
+        t0 = time.perf_counter()
+        for _ in range(interval):
+            s.step()
+        if with_ckpt:
+            s.checkpoint(d, keep=2)  # barrier sync included in the window
+        else:
+            s.block_until_ready()
+        return time.perf_counter() - t0
+
+    # interleaved best-of-N, the bench_supervision pattern: drift hits both
+    # variants evenly instead of landing whole in one delta
+    base_dt, ckpt_dt = None, None
+    for _ in range(windows):
+        dt = window(False)
+        base_dt = dt if base_dt is None else min(base_dt, dt)
+        dt = window(True)
+        ckpt_dt = dt if ckpt_dt is None else min(ckpt_dt, dt)
+
+    t0 = time.perf_counter()
+    path = s.checkpoint(d, keep=2)
+    snap_dt = time.perf_counter() - t0
+    if os.path.isdir(path):
+        size = sum(os.path.getsize(os.path.join(r, f))
+                   for r, _dirs, files in os.walk(path) for f in files)
+    else:
+        size = os.path.getsize(path)
+    if directory is None:
+        shutil.rmtree(d, ignore_errors=True)
+    return {
+        "ok": ckpt_dt >= base_dt * 0.5,  # sanity: windows were comparable
+        "base_ms_per_step": round(base_dt * 1e3 / interval, 4),
+        "ckpt_ms_per_step": round(ckpt_dt * 1e3 / interval, 4),
+        "overhead_pct": round((ckpt_dt - base_dt) / base_dt * 100.0, 2),
+        "snapshot_ms": round(snap_dt * 1e3, 2),
+        "snapshot_bytes": int(size),
+        "interval": interval,
+        "n": n,
+        "windows": windows,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny config, CPU-ok")
@@ -627,7 +697,8 @@ def main() -> None:
                                          "router", "router-api", "shard",
                                          "shard-api", "latency",
                                          "bridge-latency", "modes",
-                                         "supervision", "spawn", "stream"],
+                                         "supervision", "checkpoint-overhead",
+                                         "spawn", "stream"],
                     help="run a single config (spawn/stream are extra "
                          "JMH-analogue microbenches outside the default "
                          "10-config surface)")
@@ -815,6 +886,15 @@ def main() -> None:
                     "value": out["overhead_pct"], "unit": "pct",
                     "vs_baseline": 1.0,
                     "extra": {"supervision": out, **extra}}))
+            elif args.config == "checkpoint-overhead":
+                ck_n = min(n, 1 << 14) if on_cpu else n
+                out = bench_checkpoint(ck_n, interval=256)
+                print(json.dumps({
+                    "metric": "checkpoint barrier overhead, dynamic ring "
+                              "(interval 256, quiet path)" + scale_tag,
+                    "value": out["overhead_pct"], "unit": "pct",
+                    "vs_baseline": 1.0,
+                    "extra": {"checkpoint": out, **extra}}))
             elif args.config == "modes":
                 out = bench_modes(n, mode_steps)
                 best = max(r["msgs_per_sec"] for r in out.values()

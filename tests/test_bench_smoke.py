@@ -64,6 +64,24 @@ def test_supervision_overhead_budget():
         f"(contract: <=5% at bench scale): {out}")
 
 
+def test_checkpoint_overhead_budget():
+    """ISSUE 4 satellite: the auto-checkpoint cadence at interval 256 must
+    cost <= 5% of quiet-path step time at bench scale. bench_checkpoint
+    warms the snapshot path first (orbax bring-up on the FIRST save is
+    one-time tens of ms the cadence never pays again) and interleaves
+    best-of windows like bench_supervision. Measured ~2-5% at 32k on a
+    whole CPU; the smoke budget keeps headroom over the 5% contract for
+    CI-box noise and the suite's 8-virtual-device conftest split — a
+    regression to per-step snapshots or an unwarmed save path lands at
+    100%+ regardless of the constant."""
+    out = bench.bench_checkpoint(n=32768, interval=256, windows=2)
+    assert out["ok"], out
+    assert out["snapshot_bytes"] > 0
+    assert out["overhead_pct"] <= 10.0, (
+        f"checkpoint overhead {out['overhead_pct']}% at smoke scale "
+        f"(contract: <=5% at bench scale, interval 256): {out}")
+
+
 def test_modes_smoke_ranked_beats_reference():
     """The reason the backend seam exists: at any scale, ranked merge and
     slots must not be SLOWER than the frozen wide-sort kernels they

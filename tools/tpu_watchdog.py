@@ -201,9 +201,35 @@ def on_tpu_found(detail: str) -> None:
                         "host_checks": stats.get("host_checks"),
                         "dispatch_speedup_p50":
                             pipe.get("dispatch_speedup_p50")})
+    # checkpoint barrier on-chip: quiet-path cadence overhead at interval
+    # 256 plus snapshot duration/size — the preemption-tolerance cost row
+    # (docs/CHECKPOINT_RECOVERY.md budgets it at <= 5%)
+    run_logged("checkpoint", [sys.executable, "bench.py", "--config",
+                              "checkpoint-overhead", "--probe-timeout",
+                              "120"],
+               timeout_s=1800)
+    ckpt_out = os.path.join(REPO, "watchdog_checkpoint.out")
+    if os.path.exists(ckpt_out):
+        cj = None
+        for line in open(ckpt_out):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    cj = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
+        ck = (cj or {}).get("extra", {}).get("checkpoint", {})
+        if ck:
+            append_log({"ts": _utcnow(), "ok": bool(ck.get("ok")),
+                        "detail": "checkpoint cadence stats",
+                        "overhead_pct": ck.get("overhead_pct"),
+                        "snapshot_ms": ck.get("snapshot_ms"),
+                        "snapshot_bytes": ck.get("snapshot_bytes"),
+                        "interval": ck.get("interval"),
+                        "base_ms_per_step": ck.get("base_ms_per_step")})
     paths = [LOG, "watchdog_bench_full.out", "watchdog_attrib.out",
              "watchdog_trace.out", "watchdog_supervision.out",
-             "watchdog_bridge.out"]
+             "watchdog_bridge.out", "watchdog_checkpoint.out"]
     if last is not None:
         paths.append("BENCH_TPU.json")
     if os.path.isdir(os.path.join(REPO, "traces/tpu_r05")):
