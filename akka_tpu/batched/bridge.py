@@ -164,7 +164,11 @@ class BatchedRuntimeHandle:
                  delivery_backend: Optional[str] = None,
                  checkpoint_interval_steps: int = 0,
                  checkpoint_dir: Optional[str] = None,
-                 checkpoint_keep: int = 3):
+                 checkpoint_keep: int = 3,
+                 sentinel_threshold: float = 8.0,
+                 sentinel_heartbeat_interval: float = 0.1,
+                 sentinel_acceptable_pause: float = 3.0,
+                 sentinel_max_failovers: int = 3):
         self.capacity = capacity
         self.payload_width = payload_width
         self.out_degree = out_degree
@@ -211,6 +215,21 @@ class BatchedRuntimeHandle:
         self._next_row = 0
         self._runtime: Optional[BatchedSystem] = None
         self._lock = threading.RLock()
+
+        # detection-only shard sentinel (batched/sentinel.py): every drain
+        # feeds the [ATT_WORDS] word's progress lane to a phi-accrual
+        # detector, so a hung or preempted device surfaces as a
+        # device_suspected flight-recorder event instead of silent pump
+        # starvation. A single-device handle has nowhere to fail over TO —
+        # eviction/rebuild lives in MeshSentinel; max_failovers is carried
+        # in stats for operator parity with the sharded runtime.
+        from .sentinel import ShardProgressMonitor
+        self.sentinel_max_failovers = int(sentinel_max_failovers)
+        self._sentinel = ShardProgressMonitor(
+            threshold=sentinel_threshold,
+            heartbeat_interval=sentinel_heartbeat_interval,
+            acceptable_pause=sentinel_acceptable_pause)
+        self._sentinel_reported: set = set()
 
         # ask machinery
         self._promise_base: Optional[int] = None
@@ -746,6 +765,12 @@ class BatchedRuntimeHandle:
         work its bits call for. Returns the flag word."""
         att = np.asarray(jax.device_get(inflight.popleft()))
         self._stat_drains += 1
+        for s, phi, det in self._sentinel.observe(att):
+            if s not in self._sentinel_reported:
+                self._sentinel_reported.add(s)
+                if self.flight_recorder is not None:
+                    self.flight_recorder.device_suspected(
+                        "bridge", shard=int(s), phi=float(phi), detector=det)
         flags = int(att.reshape(-1)[ATT_FLAGS])
         self._service(flags)
         return flags
@@ -1024,6 +1049,14 @@ class BatchedRuntimeHandle:
                 "host_checks": self._stat_host_checks,
                 "dispatch_p50_us": pct(0.50),
                 "dispatch_p99_us": pct(0.99)}
+
+    def sentinel_stats(self) -> Dict[str, Any]:
+        """Detection-lane telemetry: drains observed, shards currently
+        suspected (the device behind this handle is shard 0), and the
+        failover budget carried for parity with MeshSentinel."""
+        return {"drains": self._sentinel.drains,
+                "suspected": sorted(self._sentinel.suspected()),
+                "max_failovers": self.sentinel_max_failovers}
 
     def _report_pipeline(self, fr) -> None:
         """Emit pipeline counter DELTAS as a device_pipeline event (same

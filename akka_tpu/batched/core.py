@@ -207,6 +207,10 @@ class BatchedSystem:
         # optional FlightRecorder (event/flight_recorder.py SPI): step/flush
         # events for post-mortem traces; None = zero overhead
         self.flight_recorder = None
+        # (mailbox_overflow, exchange_dropped) high-water marks already
+        # surfaced as shard_overflow warnings — counters are cumulative,
+        # warn only on growth
+        self._overflow_reported = (0, 0)
         # host mirror of the dispatched-step counter: incremented when a
         # step is DISPATCHED (device step_count lags until sync). The WAL
         # tags each staged batch with this counter — a batch staged at c is
@@ -750,7 +754,19 @@ class BatchedSystem:
         """Decode the newest host-attention word — one tiny device_get
         that (like block_until_ready) also syncs the newest dispatched
         step, since the word is a non-donated output of that program."""
-        return decode_attention(self.attention)
+        word = decode_attention(self.attention)
+        fr = self.flight_recorder
+        if fr is not None:
+            # single device = shard 0: same shard_overflow warning the
+            # sharded runtime localizes per mesh row
+            mail = int(word.get("mail_dropped", 0))
+            exch = int(word.get("exchange_dropped", 0))
+            seen_mail, seen_exch = self._overflow_reported
+            if mail > seen_mail or exch > seen_exch:
+                fr.shard_overflow("batched", shard=0, mailbox_overflow=mail,
+                                  dropped=exch)
+                self._overflow_reported = (mail, exch)
+        return word
 
     # ------------------------------------------------- checkpoint / recovery
     def checkpoint(self, directory: str, keep: Optional[int] = None) -> str:

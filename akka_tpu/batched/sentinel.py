@@ -1,0 +1,688 @@
+"""MeshSentinel: automatic shard-failure detection + degraded-mesh failover.
+
+PR 4 built the recovery substrate — checkpoint barrier, write-ahead tell
+journal, cross-device-count `_restore_resharded` — but left the trigger
+manual: a preempted or hung shard stranded the whole ShardedBatchedSystem
+until a human called restore(). This module closes the loop, porting
+Akka's cluster availability stance (phi-accrual failure detection ->
+member eviction -> the survivors keep serving) onto the mesh:
+
+  Detection   every run() already emits a per-shard attention word
+              ([n_shards, ATT_WORDS], supervision.py) whose ATT_PROGRESS
+              lane is the shard's own dispatched-step counter. Each pump
+              drain therefore doubles as a heartbeat: a lane that advanced
+              feeds that shard's PhiAccrualFailureDetector
+              (remote/failure_detector.py — the same detector the remoting
+              layer uses for peers), a lane that froze lets phi accrue. A
+              wall-clock DeadlineFailureDetector covers the no-drain case
+              where a hung dispatch means no attention word ever arrives
+              (poll(), driven by an external watchdog thread — the drain
+              path itself cannot observe its own hang).
+
+  Eviction    on suspicion the sentinel quarantines under the step lock:
+              in-flight pipeline programs are cancelled (their results
+              are abandoned, exactly as a dead device would abandon
+              them), `device_suspected`/`device_evicted` flight-recorder
+              events fire, and every outstanding ask fails fast with
+              RecoveredAskLost — promise-latch state cannot survive the
+              rebuild, and hanging the caller to timeout is strictly
+              worse (bridge.restore() parity).
+
+  Failover    rebuild the ShardedBatchedSystem on the surviving devices
+              (parallel/mesh.make_mesh(devices=survivors)), re-run the
+              recorded spawns, restore the latest snapshot through
+              `_restore_resharded` (the shard count changed, so slabs
+              re-place and per-shard counters conserve), replay the tell
+              WAL so journaled batches re-stage at their recorded
+              dispatch counters, and resume the depth-k pipeline.
+              Repeated failovers DEGRADE instead of flapping: each one
+              counts against a pattern/circuit_breaker.py breaker and
+              re-arms detection only after a pattern/backoff.py delay;
+              every failover after the first halves the pipeline depth,
+              and once the breaker opens the sentinel halts with a
+              terminal `failover_halted` event (step() raises
+              SentinelHalted) — degradation over an eviction storm.
+
+Capacity must stay constant across rebuilds (the snapshot's actor-id
+space is the behaviors' coordinate system), so it must be divisible by
+every survivor count you intend to tolerate — e.g. a multiple of 12
+survives 4 -> 3 -> 2 -> 1 on a 4-device mesh. A failover onto a count
+that does not divide capacity halts with a clear reason instead of
+silently renumbering actors.
+
+MTTR (suspicion -> first post-failover step completion) is recorded per
+failover in `failover_stats` and measured with time.perf_counter even
+when a manual detection clock is injected — detection determinism and
+honest latency accounting are different jobs.
+
+Proven by tests/test_failover.py: a chaos-killed shard
+(testkit/chaos.DeviceLossInjector, murmur3-scheduled) auto-fails-over
+with no manual call and continues bit-identically vs an uninterrupted
+twin and the numpy oracle on both delivery backends. See
+docs/FAILOVER.md for detector tuning and operational semantics.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time as _time
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..pattern.backoff import backoff_delay
+from ..pattern.circuit_breaker import CircuitBreaker
+from ..remote.failure_detector import (DeadlineFailureDetector,
+                                       FailureDetectorRegistry,
+                                       PhiAccrualFailureDetector)
+from ..parallel.mesh import make_mesh
+from .behavior import BatchedBehavior, Emit
+from .behavior import behavior as behavior_deco
+from .sharded import ShardedBatchedSystem
+from .supervision import (ATT_FLAGS, ATT_LATCH_BIT, ATT_PROGRESS, ATT_WORDS,
+                          decode_attention)
+
+
+class SentinelHalted(RuntimeError):
+    """Terminal degraded state: the failover breaker tripped (or a rebuild
+    was impossible) and the sentinel stopped stepping instead of flapping
+    through an eviction storm. The journal and snapshots are intact — a
+    human (or a supervisor tier above) decides what runs next."""
+
+
+class ShardProgressMonitor:
+    """Per-shard failure detection over host-observed attention words.
+
+    Feed every drained [n_shards, ATT_WORDS] fetch to observe(): a shard
+    whose ATT_PROGRESS lane advanced heartbeats its phi-accrual detector;
+    a frozen lane accrues phi with the injected clock until the threshold
+    trips. check_deadline() is the whole-mesh fallback for total drain
+    silence (hung dispatch): when no observation at all arrived within
+    the deadline, the stalest shard — lowest progress, then lowest index —
+    is the suspect, because per-shard phi cannot localize a fault that
+    produces no words. Shared by the MeshSentinel (acts on suspicion) and
+    the bridge pump (detection-only telemetry on a single device)."""
+
+    def __init__(self, threshold: float = 8.0,
+                 heartbeat_interval: float = 0.1,
+                 acceptable_pause: float = 1.0,
+                 clock=_time.monotonic):
+        self.clock = clock
+        self.threshold = float(threshold)
+        self.heartbeat_interval = float(heartbeat_interval)
+        self.acceptable_pause = float(acceptable_pause)
+        est = max(self.heartbeat_interval, 1e-6)
+        self._phi = FailureDetectorRegistry(
+            lambda: PhiAccrualFailureDetector(
+                threshold=self.threshold,
+                acceptable_heartbeat_pause=self.acceptable_pause,
+                first_heartbeat_estimate=est,
+                min_std_deviation=est / 4.0,
+                clock=clock))
+        self._deadline = DeadlineFailureDetector(
+            acceptable_heartbeat_pause=self.acceptable_pause,
+            heartbeat_interval=self.heartbeat_interval, clock=clock)
+        self._progress: Dict[int, int] = {}   # shard -> last seen lane value
+        self._suspected: set = set()
+        self.drains = 0
+
+    def observe(self, att) -> List[Tuple[int, float, str]]:
+        """One drained attention fetch. Returns newly suspected shards as
+        (shard, phi, detector) triples, at most once per shard until
+        unsuspect()/reset()."""
+        att = np.asarray(att).reshape(-1, ATT_WORDS)
+        self.drains += 1
+        self._deadline.heartbeat()
+        for s in range(att.shape[0]):
+            prog = int(att[s, ATT_PROGRESS])
+            last = self._progress.get(s)
+            if last is None or prog > last:
+                self._progress[s] = prog
+                self._phi.heartbeat(s)
+        newly = []
+        for s in range(att.shape[0]):
+            if s in self._suspected:
+                continue
+            if self._phi.is_monitoring(s) and not self._phi.is_available(s):
+                self._suspected.add(s)
+                newly.append((s, self._phi.phi(s), "phi-accrual"))
+        return newly
+
+    def check_deadline(self) -> Optional[Tuple[int, float, str]]:
+        """Whole-mesh drain-silence check (the hung-dispatch lane). Returns
+        one (shard, phi, "deadline") suspect or None."""
+        if not self._deadline.is_monitoring or self._deadline.is_available:
+            return None
+        if not self._progress:
+            return None
+        stale = min(self._progress, key=lambda s: (self._progress[s], s))
+        if stale in self._suspected:
+            return None
+        self._suspected.add(stale)
+        return (stale, float("inf"), "deadline")
+
+    def phi(self, shard: int) -> float:
+        return self._phi.phi(shard)
+
+    def suspected(self) -> set:
+        return set(self._suspected)
+
+    def unsuspect(self, shards) -> None:
+        """Withdraw suspicion (detection suspended during the post-failover
+        backoff window) — the shard re-trips on a later observation if its
+        lane is still frozen."""
+        for s in shards:
+            self._suspected.discard(s)
+
+    def reset(self) -> None:
+        """Forget everything — shard indices renumber after a failover."""
+        self._phi.reset()
+        self._deadline = DeadlineFailureDetector(
+            acceptable_heartbeat_pause=self.acceptable_pause,
+            heartbeat_interval=self.heartbeat_interval, clock=self.clock)
+        self._progress.clear()
+        self._suspected.clear()
+
+
+class MeshSentinel:
+    """Self-healing driver around a ShardedBatchedSystem (module docstring
+    has the full story). Drive with step(n); tell()/ask() stage messages;
+    a chaos DeviceLossInjector (testkit/chaos.py) may sit on the drain
+    path to rehearse losses deterministically."""
+
+    PROMISE_REPLY = "__promise_reply"
+    PROMISE_REPLIED = "__promise_replied"
+
+    def __init__(self, capacity: int, behaviors: Sequence[BatchedBehavior],
+                 checkpoint_dir: str,
+                 n_devices: Optional[int] = None,
+                 devices: Optional[Sequence[Any]] = None,
+                 payload_width: int = 4, out_degree: int = 1,
+                 host_inbox_per_shard: int = 256,
+                 payload_dtype=jnp.float32, axis_name: str = "shards",
+                 mailbox_slots: int = 0,
+                 delivery_backend: Optional[str] = None,
+                 pipeline_depth: int = 2, min_pipeline_depth: int = 1,
+                 checkpoint_interval_steps: int = 8,
+                 checkpoint_keep: int = 3,
+                 detector_threshold: float = 8.0,
+                 heartbeat_interval: float = 0.1,
+                 acceptable_pause: float = 1.0,
+                 max_failovers: int = 3,
+                 failover_min_backoff: float = 0.5,
+                 failover_max_backoff: float = 30.0,
+                 promise_rows: int = 0,
+                 clock=_time.monotonic,
+                 flight_recorder=None,
+                 injector=None):
+        if pipeline_depth < 1 or min_pipeline_depth < 1:
+            raise ValueError("pipeline depths must be >= 1")
+        self._capacity_arg = int(capacity)
+        if devices is None:
+            devs = list(jax.devices())
+            devices = devs[:n_devices] if n_devices else devs
+        self.devices = list(devices)
+        self.behaviors = list(behaviors)
+        self.payload_width = int(payload_width)
+        self.out_degree = int(out_degree)
+        self.host_inbox = int(host_inbox_per_shard)
+        self.payload_dtype = payload_dtype
+        self.axis_name = axis_name
+        self.mailbox_slots = int(mailbox_slots)
+        self.delivery_backend = delivery_backend
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_interval = int(checkpoint_interval_steps)
+        self.checkpoint_keep = int(checkpoint_keep)
+        self.min_pipeline_depth = int(min_pipeline_depth)
+        self.max_failovers = int(max_failovers)
+        self.promise_rows_n = int(promise_rows)
+        self.clock = clock
+        self.flight_recorder = flight_recorder
+        self.injector = injector
+        self._fo_min_backoff = float(failover_min_backoff)
+        self._fo_max_backoff = float(failover_max_backoff)
+
+        from ..persistence.tell_journal import TellJournal
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        self._journal = TellJournal(os.path.join(checkpoint_dir, "tells.wal"),
+                                    flight_recorder)
+
+        self._monitor = ShardProgressMonitor(
+            threshold=detector_threshold,
+            heartbeat_interval=heartbeat_interval,
+            acceptable_pause=acceptable_pause, clock=clock)
+        # each failover is one breaker failure — NOT one protected call:
+        # successful rebuilds must not reset the count, or an eviction
+        # storm would flap forever. After max_failovers the breaker is
+        # open and the next suspicion halts terminally (the huge reset
+        # timeout keeps it from quietly re-arming).
+        self._breaker = CircuitBreaker(None, max_failures=self.max_failovers,
+                                       call_timeout=float("inf"),
+                                       reset_timeout=1e9)
+        self._step_lock = threading.RLock()
+        self._inflight: deque = deque()  # attention-word handles, oldest first
+        self._depth = int(pipeline_depth)
+        self._halted: Optional[str] = None
+        self._failovers = 0
+        self._detect_after = 0.0   # clock() before which suspicion is ignored
+        self._mttr_t0: Optional[float] = None
+        self.failover_stats: List[Dict[str, Any]] = []
+        self._snapshotted = False
+        self._last_ckpt = 0
+        self._spawned = False      # spawn topology freezes at first step
+
+        self._waiters: Dict[int, Tuple[Future, float]] = {}
+        self._zombies: set = set()
+        self._promise_free: List[int] = []
+        self._promise_base = 0
+
+        self._spawns: List[Tuple[int, int, Optional[Dict[str, Any]]]] = []
+        if self.promise_rows_n > 0:
+            # promise rows live at the BOTTOM of the id space (first spawn
+            # record), so their base survives every rebuild unchanged
+            self._spawns.append((len(self.behaviors), self.promise_rows_n,
+                                 None))
+        self.system = self._build_system()
+        self.capacity = self.system.capacity
+        self._promise_free = list(range(self.promise_rows_n))
+
+    # ---------------------------------------------------------------- build
+    def _all_behaviors(self) -> List[BatchedBehavior]:
+        bs = list(self.behaviors)
+        if self.promise_rows_n > 0:
+            bs.append(self._promise_behavior())
+        return bs
+
+    def _promise_behavior(self) -> BatchedBehavior:
+        p_w = self.payload_width
+        reply_col, replied_col = self.PROMISE_REPLY, self.PROMISE_REPLIED
+
+        @behavior_deco("__promise",
+                       {reply_col: ((p_w,), self.payload_dtype),
+                        replied_col: ((), jnp.bool_)})
+        def promise(state, inbox, ctx):
+            got = inbox.count > 0
+            take = got & ~state[replied_col]  # first answer wins
+            return ({reply_col: jnp.where(take, inbox.sum, state[reply_col]),
+                     replied_col: state[replied_col] | got},
+                    Emit.none(self.out_degree, p_w))
+
+        return promise
+
+    def _build_system(self) -> ShardedBatchedSystem:
+        mesh = make_mesh(devices=self.devices, axis_name=self.axis_name)
+        behaviors = self._all_behaviors()
+        # first build may round capacity up (divisibility); the rounded
+        # value then pins the actor-id space for every rebuild
+        cap = getattr(self, "capacity", None) or self._capacity_arg
+        sys_ = ShardedBatchedSystem(
+            cap, behaviors, mesh=mesh,
+            payload_width=self.payload_width, out_degree=self.out_degree,
+            host_inbox_per_shard=self.host_inbox,
+            payload_dtype=self.payload_dtype, axis_name=self.axis_name,
+            mailbox_slots=self.mailbox_slots,
+            delivery_backend=self.delivery_backend,
+            attention_latch_col=(self.PROMISE_REPLIED
+                                 if self.promise_rows_n > 0 else None))
+        sys_.flight_recorder = self.flight_recorder
+        sys_.tell_journal = self._journal
+        for b_idx, n, init in self._spawns:
+            sys_.spawn_block(b_idx, n, init)
+        return sys_
+
+    # ---------------------------------------------------------------- actors
+    def spawn(self, behavior: BatchedBehavior, n: int = 1,
+              init_state: Optional[Dict[str, Any]] = None) -> np.ndarray:
+        """Allocate n rows of `behavior`. The spawn is recorded so every
+        failover rebuild replays the identical row layout; topology
+        freezes at the first step (a spawn after stepping would be lost
+        by the next snapshot restore)."""
+        if self._spawned:
+            raise RuntimeError(
+                "MeshSentinel topology is frozen after the first step: "
+                "spawn every block before stepping")
+        b_idx = (behavior if isinstance(behavior, int)
+                 else self.behaviors.index(behavior))
+        with self._step_lock:
+            rows = self.system.spawn_block(b_idx, n, init_state)
+            self._spawns.append(
+                (b_idx, n, dict(init_state) if init_state else None))
+        return rows
+
+    def tell(self, dst: int, payload, mtype: int = 0) -> None:
+        if self._halted:
+            raise SentinelHalted(self._halted)
+        with self._step_lock:
+            self.system.tell(int(dst), payload, mtype)
+
+    def ask(self, dst: int, payload, mtype: int = 0,
+            timeout: float = 5.0) -> Future:
+        """Stage a tell carrying a reserved promise row in the LAST payload
+        column (bridge DefaultCodec convention — the target behavior emits
+        its reply to that row). Resolves from the promise block on a
+        latched drain; times out against the sentinel clock; fails with
+        RecoveredAskLost if a failover evicts the mesh underneath it."""
+        if self.promise_rows_n <= 0:
+            raise RuntimeError("construct MeshSentinel with promise_rows > 0 "
+                               "to use ask()")
+        fut: Future = Future()
+        with self._step_lock:
+            if self._halted:
+                fut.set_exception(SentinelHalted(self._halted))
+                return fut
+            if not self._promise_free:
+                fut.set_exception(RuntimeError("promise rows exhausted"))
+                return fut
+            slot = self._promise_free.pop()
+            prow = self._promise_base + slot
+            pl = np.zeros(self.payload_width,
+                          dtype=jnp.dtype(self.payload_dtype))
+            arr = np.asarray(payload).reshape(-1)
+            pl[: arr.shape[0]] = arr
+            pl[-1] = prow
+            self.system.tell(int(dst), pl, mtype)
+            self._waiters[prow] = (fut, self.clock() + float(timeout))
+        return fut
+
+    # ---------------------------------------------------------------- driver
+    @property
+    def host_step(self) -> int:
+        return self.system._host_step
+
+    @property
+    def pipeline_depth(self) -> int:
+        return self._depth
+
+    @property
+    def halted(self) -> Optional[str]:
+        return self._halted
+
+    def step(self, n: int = 1) -> None:
+        """Drive n steps through the depth-k pipeline, detecting and
+        failing over as drains come back. Raises SentinelHalted once the
+        breaker has tripped the sentinel into its terminal state."""
+        if self._halted:
+            raise SentinelHalted(self._halted)
+        for _ in range(n):
+            self._enqueue_step()
+            while len(self._inflight) >= self._depth:
+                self._drain_one()
+            if self._halted:
+                raise SentinelHalted(self._halted)
+        while self._inflight:
+            self._drain_one()
+        if self._halted:
+            raise SentinelHalted(self._halted)
+
+    def _enqueue_step(self) -> None:
+        if not self._snapshotted:
+            # step-0 snapshot: a loss BEFORE the first cadence checkpoint
+            # must still have something to fail over from (the WAL replays
+            # everything staged since)
+            self.checkpoint()
+        self._spawned = True
+        with self._step_lock:
+            self.system.run(1)
+            self._inflight.append(self.system.attention)
+        if (self.checkpoint_interval > 0
+                and self.system._host_step - self._last_ckpt
+                >= self.checkpoint_interval):
+            self.checkpoint()
+
+    def _drain_one(self) -> None:
+        h = self._inflight.popleft()
+        att = np.asarray(jax.device_get(h), np.int64).reshape(-1, ATT_WORDS)
+        if self.injector is not None:
+            att = self.injector.filter_attention(att)
+        if self._mttr_t0 is not None:
+            # first completed post-failover step closes the MTTR clock
+            mttr = _time.perf_counter() - self._mttr_t0
+            self._mttr_t0 = None
+            st = self.failover_stats[-1]
+            st["mttr_s"] = mttr
+            if self.flight_recorder is not None:
+                self.flight_recorder.failover_completed(
+                    "sentinel", lost_shards=st["lost_shards"],
+                    survivors=st["survivors"],
+                    step=int(self.system._host_step), mttr_s=mttr)
+        flags = int(np.bitwise_or.reduce(att[:, ATT_FLAGS])) if att.size else 0
+        if self.promise_rows_n > 0 and (flags & ATT_LATCH_BIT):
+            self._resolve_waiters()
+        self._check_ask_deadlines()
+        self.system._note_shard_overflow(decode_attention(att))
+        newly = self._monitor.observe(att)
+        if newly:
+            if self.clock() < self._detect_after:
+                # post-failover backoff window: suspicion is deferred, not
+                # acted on — a still-frozen lane re-trips once it closes
+                self._monitor.unsuspect([s for s, _, _ in newly])
+            else:
+                self._on_suspected(newly)
+
+    def poll(self) -> None:
+        """Wall-clock deadline lane for the no-drain/hung-dispatch case:
+        call from a watchdog thread (or a test) — the drain path cannot
+        observe its own silence. Suspects the stalest shard."""
+        if self._halted:
+            return
+        hit = self._monitor.check_deadline()
+        if hit is None:
+            return
+        if self.clock() < self._detect_after:
+            self._monitor.unsuspect([hit[0]])
+            return
+        self._on_suspected([hit])
+
+    def force_evict(self, shards: Sequence[int],
+                    detector: str = "manual") -> None:
+        """Operator-initiated eviction (Akka `down()` analogue): same
+        quarantine + failover path as detector suspicion."""
+        self._on_suspected([(int(s), float("inf"), detector)
+                            for s in shards])
+
+    # -------------------------------------------------------------- failover
+    def _on_suspected(self, newly: List[Tuple[int, float, str]]) -> None:
+        fr = self.flight_recorder
+        if fr is not None:
+            for s, phi, det in newly:
+                fr.device_suspected("sentinel", shard=int(s),
+                                    phi=float(phi), detector=det)
+        self._failover([int(s) for s, _, _ in newly],
+                       detector=newly[0][2])
+
+    def _failover(self, lost: List[int], detector: str = "unknown") -> None:
+        t0 = _time.perf_counter()
+        fr = self.flight_recorder
+        with self._step_lock:
+            if self._halted:
+                return
+            if self._breaker.state == "open":
+                self._halt(f"failover breaker open after {self._failovers} "
+                           f"failovers (suspect shards {sorted(lost)})")
+                return
+            self._breaker.fail()  # each failover counts toward the trip
+            self._failovers += 1
+            step = int(self.system._host_step)
+            # quarantine under the step lock: abandon in-flight programs
+            # and evict — nothing may dispatch onto the lost mesh again
+            self._inflight.clear()
+            if fr is not None:
+                for s in lost:
+                    fr.device_evicted("sentinel", shard=int(s), step=step)
+            self._fail_waiters_lost(sorted(lost))
+            survivors = [d for i, d in enumerate(self.devices)
+                         if i not in set(lost)]
+            try:
+                if not survivors:
+                    raise RuntimeError("no surviving devices")
+                if self.capacity % len(survivors) != 0:
+                    raise RuntimeError(
+                        f"capacity {self.capacity} is not divisible by the "
+                        f"surviving shard count {len(survivors)}: provision "
+                        f"capacity as a multiple of every survivor count "
+                        f"to tolerate (docs/FAILOVER.md)")
+                self._rebuild(survivors)
+            except Exception as e:  # noqa: BLE001 — rebuild failure is terminal
+                self._halt(f"failover rebuild failed: {e}")
+                return
+            # degrade ladder: every failover after the first halves the
+            # pipeline depth — less speculation on a mesh that keeps dying
+            if self._failovers > 1:
+                self._depth = max(self.min_pipeline_depth, self._depth // 2)
+            self._detect_after = self.clock() + backoff_delay(
+                self._failovers, self._fo_min_backoff, self._fo_max_backoff)
+            self._monitor.reset()
+            self.failover_stats.append({
+                "at_clock": float(self.clock()),
+                "lost_shards": sorted(lost),
+                "survivors": len(survivors),
+                "detector": detector,
+                "evicted_at_step": step,
+                "restored_step": int(self.system._host_step),
+                "rebuild_s": _time.perf_counter() - t0,
+                "pipeline_depth": self._depth,
+                "mttr_s": None,  # closes on the first post-failover drain
+            })
+            self._mttr_t0 = t0
+
+    def _rebuild(self, survivors: List[Any]) -> None:
+        from ..persistence.slab_snapshot import latest_slab_path
+        path = latest_slab_path(self.checkpoint_dir)
+        if path is None:
+            raise RuntimeError("no snapshot to fail over from")
+        self.devices = list(survivors)
+        self.system = self._build_system()
+        self.system.restore(path, journal=self._journal)
+        if self.promise_rows_n > 0:
+            # latch state does not survive the rebuild: lower every latch
+            # (a replayed ask may have re-latched during WAL replay) and
+            # reset the slot pool — the waiters already failed
+            self._lower_latches(range(self.promise_rows_n))
+            self._promise_free = list(range(self.promise_rows_n))
+            self._zombies.clear()
+        self._last_ckpt = self.system._host_step
+
+    def _halt(self, reason: str) -> None:
+        self._halted = reason
+        self._inflight.clear()
+        self._fail_waiters(SentinelHalted(reason))
+        if self.flight_recorder is not None:
+            self.flight_recorder.failover_halted(
+                "sentinel", failovers=self._failovers, reason=reason)
+
+    def _fail_waiters_lost(self, lost: List[int]) -> None:
+        from .bridge import RecoveredAskLost  # lazy: bridge imports us
+        self._fail_waiters(RecoveredAskLost(
+            f"mesh failover evicted shards {lost}; outstanding asks "
+            f"cannot resolve across the rebuild — re-issue against the "
+            f"restored system"))
+
+    def _fail_waiters(self, exc: Exception) -> None:
+        for _prow, (fut, _dl) in list(self._waiters.items()):
+            if not fut.done():
+                fut.set_exception(exc)
+        self._waiters.clear()
+        self._zombies.clear()
+
+    # ------------------------------------------------------------------ asks
+    def _resolve_waiters(self) -> None:
+        with self._step_lock:
+            base, n = self._promise_base, self.promise_rows_n
+            ids = np.arange(base, base + n)
+            replied = np.asarray(
+                self.system.read_state(self.PROMISE_REPLIED, ids))
+            reply = np.asarray(self.system.read_state(self.PROMISE_REPLY, ids))
+            clear: List[int] = []
+            for prow, (fut, _dl) in list(self._waiters.items()):
+                i = prow - base
+                if replied[i]:
+                    if not fut.done():
+                        fut.set_result(np.array(reply[i]))
+                    del self._waiters[prow]
+                    self._promise_free.append(i)
+                    clear.append(i)
+            for prow in list(self._zombies):
+                i = prow - base
+                if replied[i]:  # late reply to a timed-out ask: reclaim
+                    self._zombies.discard(prow)
+                    self._promise_free.append(i)
+                    clear.append(i)
+            owned = {p - base for p in self._waiters} | \
+                    {p - base for p in self._zombies}
+            for i in np.nonzero(replied)[0]:
+                i = int(i)
+                if i not in owned and i not in clear:
+                    clear.append(i)  # replayed ask with no waiter: lower only
+            if clear:
+                self._lower_latches(clear)
+
+    def _check_ask_deadlines(self) -> None:
+        if not self._waiters:
+            return
+        now = self.clock()
+        with self._step_lock:
+            for prow, (fut, deadline) in list(self._waiters.items()):
+                if now >= deadline:
+                    del self._waiters[prow]
+                    # quarantine the slot until its latch is observed — a
+                    # late reply must never resolve a REUSED slot
+                    self._zombies.add(prow)
+                    from ..pattern.ask import AskTimeoutException
+                    if not fut.done():
+                        fut.set_exception(AskTimeoutException(
+                            f"ask on promise row {prow} timed out"))
+
+    def _lower_latches(self, slots) -> None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        rows = jnp.asarray(np.asarray(
+            [self._promise_base + int(s) for s in slots], np.int32))
+        shard = NamedSharding(self.system.mesh, P(self.axis_name))
+        col = self.system.state[self.PROMISE_REPLIED]
+        self.system.state[self.PROMISE_REPLIED] = jax.device_put(
+            col.at[rows].set(False), shard)
+
+    # ------------------------------------------------------------- telemetry
+    def checkpoint(self) -> str:
+        t0 = _time.perf_counter()
+        with self._step_lock:
+            path = self.system.checkpoint(self.checkpoint_dir,
+                                          keep=self.checkpoint_keep)
+        self._snapshotted = True
+        self._last_ckpt = self.system._host_step
+        if self.flight_recorder is not None:
+            try:
+                size = os.path.getsize(path) if os.path.isfile(path) else 0
+            except OSError:
+                size = 0
+            self.flight_recorder.device_checkpoint(
+                "sentinel", int(self.system._host_step),
+                _time.perf_counter() - t0, size, path)
+        return path
+
+    def read_state(self, col: str, ids=None) -> np.ndarray:
+        return self.system.read_state(col, ids)
+
+    def read_attention(self) -> Dict[str, Any]:
+        return self.system.read_attention()
+
+    def sentinel_stats(self) -> Dict[str, Any]:
+        return {
+            "devices": len(self.devices),
+            "failovers": self._failovers,
+            "halted": self._halted,
+            "pipeline_depth": self._depth,
+            "drains": self._monitor.drains,
+            "suspected": sorted(self._monitor.suspected()),
+            "failover_stats": [dict(s) for s in self.failover_stats],
+        }
+
+    def shutdown(self) -> None:
+        with self._step_lock:
+            self._inflight.clear()
+            self._fail_waiters(SentinelHalted("sentinel shut down"))
+            self._journal.close()

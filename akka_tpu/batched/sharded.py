@@ -64,7 +64,8 @@ class ShardedBatchedSystem:
                  mailbox_slots: int = 0, reroute_strays: bool = False,
                  spill_capacity: Optional[int] = None,
                  delivery: str = "auto",
-                 delivery_backend: Optional[str] = None):
+                 delivery_backend: Optional[str] = None,
+                 attention_latch_col: Optional[str] = None):
         self.mesh = mesh if mesh is not None else make_mesh(n_devices, axis_name)
         self.axis = axis_name
         self.n_shards = self.mesh.shape[axis_name]
@@ -171,12 +172,22 @@ class ShardedBatchedSystem:
         # COUNTER_NAMES order) — summed over shards on host read
         self.sup_counts = jax.device_put(
             jnp.zeros((self.n_shards, N_COUNTERS), jnp.int32), shard)
-        # host-attention word (supervision.pack_attention): replicated
-        # [ATT_WORDS] summary recomputed from the final carry of every
-        # run() — the pipelined driver syncs on this handle instead of
-        # step_count and reads the flag bits with ONE tiny device_get
+        # host-attention words (supervision.pack_attention): one
+        # [ATT_WORDS] row PER SHARD, sharded with everything else, each
+        # recomputed from the final carry of every run(). The pipelined
+        # driver syncs on this handle instead of step_count and reads the
+        # whole mesh's flags/counters/progress lanes with ONE tiny
+        # device_get — row s's ATT_PROGRESS is shard s's heartbeat (the
+        # MeshSentinel's detection input, batched/sentinel.py)
         self.attention = jax.device_put(
-            jnp.zeros((ATT_WORDS,), jnp.int32), NamedSharding(self.mesh, P()))
+            jnp.zeros((self.n_shards, ATT_WORDS), jnp.int32), shard)
+        # cumulative per-shard overflow already reported via the
+        # shard_overflow flight-recorder warning (read_attention)
+        self._overflow_reported = np.zeros((self.n_shards, 2), np.int64)
+        # optional FlightRecorder (event/flight_recorder.py SPI); the
+        # sentinel wires its recorder here so shard_overflow warnings and
+        # checkpoint events share one stream. None = zero overhead.
+        self.flight_recorder = None
 
         self._next_row = 0
         self._lock = threading.Lock()
@@ -198,7 +209,8 @@ class ShardedBatchedSystem:
                               n_global=self.capacity,
                               delivery=delivery,
                               delivery_backend=delivery_backend,
-                              spill_cap=self.spill_cap)
+                              spill_cap=self.spill_cap,
+                              attention_latch_col=attention_latch_col)
         self._step_fn = None  # built lazily: tables may be set post-init
         self._step_cache: Dict[bool, Any] = {}  # stray-mode -> compiled step
 
@@ -354,6 +366,18 @@ class ShardedBatchedSystem:
         sharded = shard_map(local_step, mesh=mesh, in_specs=in_specs,
                             out_specs=out_specs, check_vma=False)
 
+        # per-shard attention packing over the final carry: each shard
+        # reduces ITS local blocks into one [ATT_WORDS] row (local flags,
+        # local overflow counters, its own progress lane), so the stacked
+        # [n_shards, ATT_WORDS] word stays sharded and a single host fetch
+        # reads every shard's heartbeat
+        att_map = shard_map(
+            lambda st, dr, md, sc_, stp: core.attention_word(
+                st, md, sc_, stp, exch_dropped=dr).reshape(1, ATT_WORDS),
+            mesh=mesh,
+            in_specs=(state_specs, P(axis), P(axis), P(axis), P()),
+            out_specs=P(axis), check_vma=False)
+
         def multi_step(state, behavior_id, alive, inbox_dst, inbox_type,
                        inbox_payload, inbox_valid, dropped, mail_dropped,
                        sup_counts, step_count, tables, n_steps: int):
@@ -363,12 +387,12 @@ class ShardedBatchedSystem:
                      inbox_payload, inbox_valid, dropped, mail_dropped,
                      sup_counts, step_count)
             carry, _ = jax.lax.scan(body, carry, None, length=n_steps)
-            # host-attention word from the final carry: every field is
+            # host-attention words from the final carry: every field is
             # carry-derived (flags = current state, counters cumulative),
-            # so one cross-shard reduction per run() covers the window —
+            # so one per-shard reduction per run() covers the window —
             # nothing rides the scan. Appended OUTSIDE the donation set.
-            attention = core.attention_word(carry[0], carry[8], carry[9],
-                                            carry[10])
+            attention = att_map(carry[0], carry[7], carry[8], carry[9],
+                                carry[10])
             return carry + (attention,)
 
         # pin output shardings to the INPUT shardings: without this, GSPMD
@@ -379,7 +403,7 @@ class ShardedBatchedSystem:
         repl_s = NamedSharding(mesh, P())
         out_shardings = ({k: shard_s for k in self.state_spec},
                          shard_s, shard_s, shard_s, shard_s, shard_s,
-                         shard_s, shard_s, shard_s, shard_s, repl_s, repl_s)
+                         shard_s, shard_s, shard_s, shard_s, repl_s, shard_s)
         return jax.jit(multi_step, static_argnums=(12,),
                        donate_argnums=tuple(range(10)),
                        out_shardings=out_shardings)
@@ -574,10 +598,35 @@ class ShardedBatchedSystem:
         drive_pipelined(lambda: self.run(1), lambda: self.attention,
                         n_steps, depth, on_drain=cb)
 
-    def read_attention(self) -> Dict[str, int]:
-        """Decode the newest host-attention word — one tiny device_get
-        that also syncs the newest dispatched run (non-donated output)."""
-        return decode_attention(self.attention)
+    def read_attention(self) -> Dict[str, Any]:
+        """Decode the newest host-attention words — one tiny device_get
+        that also syncs the newest dispatched run (non-donated output).
+        The decoded dict carries per-shard columns (`*_per_shard`) on top
+        of the global totals: `mail_dropped_per_shard` / `dropped_per_shard`
+        localize overflow to the shard losing mail, and
+        `progress_per_shard` is the heartbeat lane. A shard whose overflow
+        counters GREW since the last read raises one `shard_overflow`
+        flight-recorder warning — the "slow shard" signal, distinct from
+        the frozen-progress "dead shard" signal the sentinel acts on."""
+        word = decode_attention(self.attention)
+        self._note_shard_overflow(word)
+        return word
+
+    def _note_shard_overflow(self, word: Dict[str, Any]) -> None:
+        fr = self.flight_recorder
+        if fr is None:
+            return
+        mail = np.asarray(word.get("mail_dropped_per_shard", ()), np.int64)
+        exch = np.asarray(word.get("dropped_per_shard", ()), np.int64)
+        if mail.shape[0] != self.n_shards:
+            return  # decoded from a foreign/legacy word; nothing to localize
+        for s in range(self.n_shards):
+            seen_mail, seen_exch = self._overflow_reported[s]
+            if mail[s] > seen_mail or exch[s] > seen_exch:
+                fr.shard_overflow("sharded", shard=s,
+                                  mailbox_overflow=int(mail[s]),
+                                  dropped=int(exch[s]))
+                self._overflow_reported[s] = (int(mail[s]), int(exch[s]))
 
     def read_state(self, col: str, ids: Optional[np.ndarray] = None) -> np.ndarray:
         """Host copy of one state column. Implicitly drains the dispatch
@@ -645,6 +694,16 @@ class ShardedBatchedSystem:
     @property
     def mailbox_overflow(self) -> int:
         return int(jnp.sum(self.mail_dropped))
+
+    @property
+    def dropped_per_shard(self) -> np.ndarray:
+        """[n_shards] cumulative exchange-overflow counts (host copy)."""
+        return np.asarray(jax.device_get(self.dropped), np.int64)
+
+    @property
+    def mailbox_overflow_per_shard(self) -> np.ndarray:
+        """[n_shards] cumulative mailbox-overflow counts (host copy)."""
+        return np.asarray(jax.device_get(self.mail_dropped), np.int64)
 
     def block_until_ready(self) -> None:
         # sync via host read of a non-donated output (see core.py note)
@@ -735,11 +794,23 @@ class ShardedBatchedSystem:
         self.step_count = jax.device_put(
             jnp.asarray(np.asarray(tree["step_count"]).max(), jnp.int32),
             repl)
-        att = tree.get("attention")
-        self.attention = jax.device_put(
-            jnp.asarray(att, jnp.int32) if att is not None
-            else jnp.zeros((ATT_WORDS,), jnp.int32), repl)
         ns = self.n_shards
+        # attention words are a per-shard summary of the carry: conserve
+        # them like the other per-shard aggregates (flags OR, counters sum
+        # into row 0, step/progress max) rather than copying a [old_ns, W]
+        # block that no longer matches this mesh. Rows beyond 0 re-fill on
+        # the first restored step.
+        att_rows = np.zeros((ns, ATT_WORDS), np.int32)
+        self._overflow_reported = np.zeros((ns, 2), np.int64)
+        att = tree.get("attention")
+        if att is not None:
+            old = decode_attention(np.asarray(att))
+            att_rows[0] = (old["flags"], old["mail_dropped"],
+                           old["dead_letters"], old["step"],
+                           old["exchange_dropped"], old["step"])
+            self._overflow_reported[0] = (old["mail_dropped"],
+                                          old["exchange_dropped"])
+        self.attention = jax.device_put(jnp.asarray(att_rows), shard)
         dropped = np.zeros((ns,), np.int32)
         dropped[0] = int(np.asarray(tree.get("dropped", 0)).sum())
         self.dropped = jax.device_put(jnp.asarray(dropped), shard)

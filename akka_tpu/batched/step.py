@@ -274,7 +274,8 @@ class StepCore:
                 step=step_count)
         return new_state, new_behavior_id, new_alive, emits, sup_delta
 
-    def attention_word(self, state, mail_dropped, sup_counts, step_count):
+    def attention_word(self, state, mail_dropped, sup_counts, step_count,
+                       exch_dropped=None):
         """[ATT_WORDS] int32 host-attention word for the step that produced
         these carries (supervision.pack_attention over this core's latch
         column). Emitted as a NON-donated output of the jitted step so a
@@ -282,9 +283,12 @@ class StepCore:
         the depth-k pump reads this instead of `block_until_ready` plus
         wide per-column fetches. Accepts scalar or per-shard blocks for
         mail_dropped / sup_counts (shard_map callers pass their local
-        blocks and reshape the result to [1, ATT_WORDS])."""
+        blocks and reshape the result to [1, ATT_WORDS], yielding the
+        per-shard word whose counter/progress lanes feed the sentinel);
+        `exch_dropped` is the caller's exchange-overflow aggregate."""
         return pack_attention(state, mail_dropped, sup_counts, step_count,
-                              latch_col=self.attention_latch_col)
+                              latch_col=self.attention_latch_col,
+                              exch_dropped=exch_dropped)
 
     def run_local(self, state, behavior_id, alive, inbox_dst, inbox_type,
                   inbox_payload, inbox_valid, step_count, topo_arrays=(),

@@ -290,13 +290,25 @@ def counts_dict(vec) -> Dict[str, int]:
 # NON-donated output of the jitted step, so `device_get` on its handle
 # doubles as the sync point for that step's whole program.
 
-ATT_WORDS = 4
-ATT_FLAGS, ATT_DROPPED, ATT_DEAD_LETTERS, ATT_STEP = range(ATT_WORDS)
+ATT_WORDS = 6
+(ATT_FLAGS, ATT_DROPPED, ATT_DEAD_LETTERS, ATT_STEP,
+ ATT_EXCH_DROPPED, ATT_PROGRESS) = range(ATT_WORDS)
 
 # ATT_FLAGS bit layout
 ATT_FAILED_BIT = 1     # some lane holds `_failed` (feeds _handle_failures)
 ATT_ESCALATED_BIT = 2  # some lane holds `_escalated` (host must resolve)
 ATT_LATCH_BIT = 4      # some promise row latched a reply (bridge asks)
+
+# Word semantics when the word is packed PER SHARD ([n_shards, ATT_WORDS],
+# the ShardedBatchedSystem layout): ATT_DROPPED / ATT_DEAD_LETTERS /
+# ATT_EXCH_DROPPED hold the packing shard's LOCAL cumulative counts (their
+# sum across rows is the global total, which is what decode_attention
+# reports), and ATT_PROGRESS is the shard's own dispatched-step counter —
+# the per-shard heartbeat lane. A live shard's progress word advances on
+# every drained program; a preempted or hung shard's lane freezes at its
+# last completed step, which is exactly the signal the MeshSentinel's
+# phi-accrual detectors consume (batched/sentinel.py). On a single device
+# ATT_PROGRESS mirrors ATT_STEP and ATT_EXCH_DROPPED is 0 (no exchange).
 
 
 def attention_flags(state: Dict[str, jax.Array],
@@ -318,25 +330,47 @@ def attention_flags(state: Dict[str, jax.Array],
 
 
 def pack_attention(state: Dict[str, jax.Array], mail_dropped, sup_counts,
-                   step_count, latch_col: Optional[str] = None) -> jax.Array:
+                   step_count, latch_col: Optional[str] = None,
+                   exch_dropped=None, progress=None) -> jax.Array:
     """[ATT_WORDS] int32 attention word for one step (traced in-graph).
     `mail_dropped` / `sup_counts` may be scalars or per-shard blocks —
     both reduce to totals here, so single-device and shard_map callers
-    share the packing."""
+    share the packing. `exch_dropped` is the caller's exchange-overflow
+    aggregate (sharded: the per-pair drop counter block; absent on a
+    single device); `progress` overrides the heartbeat lane (defaults to
+    step_count — a shard_map caller inside a sharded step passes its own
+    counter, which is the same value but packed per shard)."""
     i32 = jnp.int32
     dropped = jnp.sum(jnp.asarray(mail_dropped)).astype(i32)
     dead = jnp.reshape(jnp.asarray(sup_counts),
                        (-1, N_COUNTERS))[:, DEAD_LETTERS].sum().astype(i32)
+    step = jnp.asarray(step_count).astype(i32)
+    exch = (jnp.sum(jnp.asarray(exch_dropped)).astype(i32)
+            if exch_dropped is not None else jnp.asarray(0, i32))
+    prog = (jnp.asarray(progress).astype(i32).reshape(())
+            if progress is not None else step)
     return jnp.stack([attention_flags(state, latch_col), dropped, dead,
-                      jnp.asarray(step_count).astype(i32)])
+                      step, exch, prog])
 
 
 def decode_attention(word) -> Dict[str, Any]:
     """Host-side decode of attention word(s): [ATT_WORDS] or, sharded,
     [n_shards, ATT_WORDS]. Flags OR across shards, counters sum, step
-    takes the max (it is replicated, so any shard's value is the step)."""
+    takes the max. Per-shard counter columns are also surfaced raw
+    (`*_per_shard` numpy rows, one entry per word) so the sentinel and
+    read_attention() callers can tell WHICH shard is overflowing or
+    stalled without another device round-trip. Legacy 4-word arrays
+    (pre-progress-lane snapshots) decode with the new lanes zeroed."""
     import numpy as np
-    a = np.asarray(jax.device_get(word), np.int64).reshape(-1, ATT_WORDS)
+    a = np.asarray(jax.device_get(word), np.int64)
+    if a.size % ATT_WORDS != 0 and a.size % 4 == 0:
+        # pre-v3 word layout: [flags, dropped, dead_letters, step]
+        legacy = a.reshape(-1, 4)
+        a = np.zeros((legacy.shape[0], ATT_WORDS), np.int64)
+        a[:, :4] = legacy
+        a[:, ATT_PROGRESS] = legacy[:, ATT_STEP]
+    else:
+        a = a.reshape(-1, ATT_WORDS)
     flags = int(np.bitwise_or.reduce(a[:, ATT_FLAGS])) if a.size else 0
     return {
         "flags": flags,
@@ -346,4 +380,8 @@ def decode_attention(word) -> Dict[str, Any]:
         "mail_dropped": int(a[:, ATT_DROPPED].sum()),
         "dead_letters": int(a[:, ATT_DEAD_LETTERS].sum()),
         "step": int(a[:, ATT_STEP].max()) if a.size else 0,
+        "exchange_dropped": int(a[:, ATT_EXCH_DROPPED].sum()),
+        "mail_dropped_per_shard": a[:, ATT_DROPPED].copy(),
+        "dropped_per_shard": a[:, ATT_EXCH_DROPPED].copy(),
+        "progress_per_shard": a[:, ATT_PROGRESS].copy(),
     }

@@ -184,6 +184,16 @@ def reference_config() -> Config:
                     "checkpoint-interval-steps": 0,
                     "checkpoint-dir": "",
                     "checkpoint-keep": 3,
+                    # shard-failure sentinel (batched/sentinel.py): phi
+                    # threshold + expected heartbeat cadence for the
+                    # progress-lane detector, the wall-clock pause before
+                    # a silent mesh is declared hung, and how many
+                    # automatic failovers may run before the breaker
+                    # halts the runtime degraded (docs/FAILOVER.md)
+                    "sentinel-threshold": 8.0,
+                    "sentinel-heartbeat-interval": "100ms",
+                    "sentinel-acceptable-pause": "3s",
+                    "sentinel-max-failovers": 3,
                     "mesh-axes": {},
                 },
                 "default-mailbox": {

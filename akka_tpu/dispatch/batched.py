@@ -65,6 +65,19 @@ class TpuBatchedDispatcher(Dispatcher):
                         c.get_string("checkpoint-dir", "") or None),
                     checkpoint_keep=overrides.get(
                         "checkpoint_keep", c.get_int("checkpoint-keep", 3)),
+                    sentinel_threshold=overrides.get(
+                        "sentinel_threshold",
+                        c.get_float("sentinel-threshold", 8.0)),
+                    sentinel_heartbeat_interval=overrides.get(
+                        "sentinel_heartbeat_interval",
+                        c.get_duration("sentinel-heartbeat-interval",
+                                       "100ms")),
+                    sentinel_acceptable_pause=overrides.get(
+                        "sentinel_acceptable_pause",
+                        c.get_duration("sentinel-acceptable-pause", "3s")),
+                    sentinel_max_failovers=overrides.get(
+                        "sentinel_max_failovers",
+                        c.get_int("sentinel-max-failovers", 3)),
                 )
             return self._handle
 

@@ -84,6 +84,29 @@ class FlightRecorder:
 
     def journal_truncated(self, path: str, dropped_bytes: int) -> None: ...
 
+    # failure detection / degraded-mesh failover (batched/sentinel.py):
+    # device_suspected when a shard's heartbeat lane trips its detector
+    # (phi-accrual on frozen progress, or the wall-clock drain deadline);
+    # device_evicted once the sentinel quarantines it; failover_completed
+    # after the surviving-mesh rebuild resumes stepping (mttr_s measures
+    # suspicion -> first post-failover step); failover_halted is TERMINAL —
+    # the failover breaker tripped and the runtime stopped instead of
+    # flapping; shard_overflow localizes mailbox/exchange overflow to one
+    # shard (the "slow, not dead" warning)
+    def device_suspected(self, system: str, shard: int, phi: float,
+                         detector: str) -> None: ...
+
+    def device_evicted(self, system: str, shard: int, step: int) -> None: ...
+
+    def failover_completed(self, system: str, lost_shards, survivors: int,
+                           step: int, mttr_s: float) -> None: ...
+
+    def failover_halted(self, system: str, failovers: int,
+                        reason: str) -> None: ...
+
+    def shard_overflow(self, system: str, shard: int, mailbox_overflow: int,
+                       dropped: int) -> None: ...
+
     # -- generic escape hatch ------------------------------------------------
     def event(self, name: str, **fields: Any) -> None: ...
 
@@ -100,8 +123,8 @@ class NoOpFlightRecorder(FlightRecorder):
 
 
 def _structured(method_name):
-    def hook(self, *args):
-        self._record(method_name, args)
+    def hook(self, *args, **kwargs):
+        self._record(method_name, args, kwargs)
     return hook
 
 
@@ -131,16 +154,24 @@ class InMemoryFlightRecorder(FlightRecorder):
                               "path"),
         "checkpoint_failed": ("system", "error", "consecutive"),
         "journal_truncated": ("path", "dropped_bytes"),
+        "device_suspected": ("system", "shard", "phi", "detector"),
+        "device_evicted": ("system", "shard", "step"),
+        "failover_completed": ("system", "lost_shards", "survivors", "step",
+                               "mttr_s"),
+        "failover_halted": ("system", "failovers", "reason"),
+        "shard_overflow": ("system", "shard", "mailbox_overflow", "dropped"),
     }
 
     def __init__(self, capacity: int = 4096):
         self._buf: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
 
-    def _record(self, name: str, args) -> None:
+    def _record(self, name: str, args, kwargs=None) -> None:
         ev = {"event": name, "ts": time.time()}
         for field, value in zip(self._FIELDS.get(name, ()), args):
             ev[field] = value
+        if kwargs:
+            ev.update(kwargs)
         self._append(ev)
 
     def _append(self, ev: Dict[str, Any]) -> None:

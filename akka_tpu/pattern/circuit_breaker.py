@@ -34,6 +34,13 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at = 0.0
         self._current_reset = reset_timeout
+        # half-open admits exactly ONE probe (reference :416 attemptReset —
+        # the transition swaps in a single-permit gate): the first caller
+        # claims this flag under the lock; every other caller fails fast
+        # with CircuitBreakerOpenException until the probe reports. A probe
+        # that raises re-opens atomically in fail(), which also restarts
+        # the reset timer (_trip_open re-stamps _opened_at).
+        self._probe_in_flight = False
         self._lock = threading.RLock()
         self._on_open: List[Callable[[], None]] = []
         self._on_close: List[Callable[[], None]] = []
@@ -63,6 +70,7 @@ class CircuitBreaker:
     def _trip_open(self) -> None:
         self._state = "open"
         self._opened_at = time.monotonic()
+        self._probe_in_flight = False
         for cb in self._on_open:
             cb()
 
@@ -70,17 +78,26 @@ class CircuitBreaker:
         self._state = "closed"
         self._failures = 0
         self._current_reset = self.reset_timeout
+        self._probe_in_flight = False
         for cb in self._on_close:
             cb()
+
+    def _admit(self) -> None:
+        """Gate one call attempt (caller holds the lock): open -> fail
+        fast; half-open -> admit only the single probe, racing callers
+        fail fast until it reports via succeed()/fail()."""
+        self._maybe_half_open()
+        if self._state == "open" or (self._state == "half-open"
+                                     and self._probe_in_flight):
+            remaining = self._current_reset - (time.monotonic() - self._opened_at)
+            raise CircuitBreakerOpenException(max(remaining, 0.0))
+        if self._state == "half-open":
+            self._probe_in_flight = True
 
     # -- call protection -----------------------------------------------------
     def with_sync_circuit_breaker(self, body: Callable[[], Any]) -> Any:
         with self._lock:
-            self._maybe_half_open()
-            state = self._state
-            if state == "open":
-                remaining = self._current_reset - (time.monotonic() - self._opened_at)
-                raise CircuitBreakerOpenException(max(remaining, 0.0))
+            self._admit()
         start = time.monotonic()
         try:
             result = body()
@@ -98,10 +115,10 @@ class CircuitBreaker:
     def with_circuit_breaker(self, body: Callable[[], Future]) -> Future:
         out: Future = Future()
         with self._lock:
-            self._maybe_half_open()
-            if self._state == "open":
-                remaining = self._current_reset - (time.monotonic() - self._opened_at)
-                out.set_exception(CircuitBreakerOpenException(max(remaining, 0.0)))
+            try:
+                self._admit()
+            except CircuitBreakerOpenException as e:
+                out.set_exception(e)
                 return out
         start = time.monotonic()
         try:
@@ -136,6 +153,9 @@ class CircuitBreaker:
     def fail(self) -> None:
         with self._lock:
             if self._state == "half-open":
+                # atomic re-open: backoff the reset and restart its timer
+                # (_trip_open re-stamps _opened_at) in the same critical
+                # section that releases the probe permit
                 self._current_reset = min(self._current_reset * self.backoff_factor,
                                           self.max_reset_timeout)
                 self._trip_open()

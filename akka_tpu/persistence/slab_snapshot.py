@@ -128,6 +128,14 @@ def restore_slab_pytree(system, tree: Dict[str, Any]) -> None:
             arr = tree[k]
             if hasattr(cur, "shape") and tuple(cur.shape) != tuple(
                     np.asarray(arr).shape):
+                if k == "attention":
+                    # derived per-step summary, not source state: a layout
+                    # change (e.g. the 4-word pre-progress-lane format, or
+                    # per-shard rows from another mesh) zero-fills and the
+                    # first restored step repacks it
+                    setattr(system, k, _put_like(
+                        system, jnp.zeros(cur.shape, cur.dtype), cur))
+                    continue
                 raise ValueError(
                     f"slab shape mismatch for {k}: "
                     f"{np.asarray(arr).shape} vs {tuple(cur.shape)}")

@@ -194,6 +194,16 @@ class FailureDetectorRegistry(Generic[T]):
         fd = self._detectors.get(resource)
         return fd.is_monitoring if fd is not None else False
 
+    def phi(self, resource: T) -> float:
+        """Current suspicion level of a monitored resource: the detector's
+        phi for accrual detectors, 0.0 for boolean detectors or resources
+        never heartbeated. The sentinel records this in device_suspected
+        events so a post-mortem shows HOW suspicious the shard looked."""
+        fd = self._detectors.get(resource)
+        if fd is None:
+            return 0.0
+        return float(fd.phi()) if hasattr(fd, "phi") else 0.0
+
     def remove(self, resource: T) -> None:
         with self._lock:
             self._detectors.pop(resource, None)

@@ -127,8 +127,128 @@ def chaos_hit_np(seed: int, step, lane, rate: float, salt: int = 0):
     return h.astype(np.uint64) < np.uint64(thr)
 
 
-# salts decorrelating the four fault kinds (shared with oracles)
+# salts decorrelating the fault kinds (shared with oracles). LOSS/STALL
+# key on (step, shard) instead of (step, lane): they model DEVICE faults
+# (preemption, hung dispatch), not actor faults
 CRASH_SALT, NAN_SALT, DROP_SALT, DUP_SALT = 1, 2, 3, 4
+LOSS_SALT, STALL_SALT = 5, 6
+
+
+def loss_schedule(seed: int, steps: int, n_shards: int, rate: float,
+                  salt: int = LOSS_SALT):
+    """jnp [steps, n_shards] bool: does shard s suffer a device fault at
+    step t? Same murmur3 schedule primitive as the actor-fault masks, so
+    the SAME seed yields the SAME loss schedule on every backend — the
+    failover parity suite replays it against a numpy twin."""
+    step = jnp.repeat(jnp.arange(steps, dtype=jnp.uint32), n_shards)
+    shard = jnp.tile(jnp.arange(n_shards, dtype=jnp.uint32), steps)
+    return chaos_hit(seed, step, shard, rate, salt).reshape(steps, n_shards)
+
+
+def loss_schedule_np(seed: int, steps: int, n_shards: int, rate: float,
+                     salt: int = LOSS_SALT) -> np.ndarray:
+    """numpy twin of loss_schedule — bit-identical by the chaos_hit
+    contract."""
+    step = np.repeat(np.arange(steps, dtype=np.uint32), n_shards)
+    shard = np.tile(np.arange(n_shards, dtype=np.uint32), steps)
+    return chaos_hit_np(seed, step, shard, rate, salt).reshape(
+        steps, n_shards)
+
+
+class DeviceLossInjector:
+    """Deterministic device-loss/stall injection for the MeshSentinel
+    (batched/sentinel.py).
+
+    A real shard loss is invisible to the host except through SILENCE: the
+    device stops completing programs, so the shard's attention row — its
+    heartbeat — stops advancing. This injector reproduces exactly that
+    signature on a healthy simulation mesh: it rewrites the HOST-OBSERVED
+    copy of the per-shard attention words ([n_shards, ATT_WORDS]), freezing
+    a chaos-chosen shard's row at its last pre-fault observation. Device
+    state is never touched, which gives the quiet-path guarantee for free:
+    with `enabled=False` (or zero rates) the filter is the identity and the
+    run is bit-identical to an uninjected one — asserted, not assumed, by
+    tests/test_failover.py on both delivery backends.
+
+    Two fault kinds, both keyed on the murmur3 (step, shard) schedule:
+
+      loss_rate   permanent — the shard dies at its first scheduled step
+                  and its row freezes forever (preemption)
+      stall_rate  transient — the row freezes for `stall_steps` observed
+                  steps, then thaws (GC pause / slow collective): long
+                  enough stalls trip the detector exactly like a loss,
+                  short ones only dent phi
+    """
+
+    def __init__(self, seed: int, n_shards: int, loss_rate: float = 0.0,
+                 stall_rate: float = 0.0, stall_steps: int = 4,
+                 enabled: bool = True):
+        self.seed = int(seed)
+        self.n_shards = int(n_shards)
+        self.loss_rate = float(loss_rate)
+        self.stall_rate = float(stall_rate)
+        self.stall_steps = int(stall_steps)
+        self.enabled = bool(enabled)
+        self._loss_at = {}        # shard -> first scheduled loss step
+        self._loss_scanned = 0    # steps [0, _loss_scanned) already hashed
+        self._frozen = {}         # shard -> frozen attention row (np copy)
+        self._prev = {}           # shard -> last observed row (np copy)
+
+    def lost_at(self, shard: int, upto_step: int):
+        """First scheduled loss step for `shard` that is <= upto_step, or
+        None. Pure function of (seed, schedule) — the parity tests use it
+        to predict WHEN the sentinel must fail over."""
+        if self.loss_rate <= 0.0:
+            return None
+        if upto_step >= self._loss_scanned:
+            steps = np.arange(self._loss_scanned, upto_step + 1,
+                              dtype=np.uint32)
+            for s in range(self.n_shards):
+                if s in self._loss_at:
+                    continue
+                hits = chaos_hit_np(self.seed, steps,
+                                    np.full_like(steps, s),
+                                    self.loss_rate, LOSS_SALT)
+                idx = np.nonzero(hits)[0]
+                if idx.size:
+                    self._loss_at[s] = int(steps[idx[0]])
+            self._loss_scanned = upto_step + 1
+        at = self._loss_at.get(shard)
+        return at if at is not None and at <= upto_step else None
+
+    def _stalled(self, shard: int, step: int) -> bool:
+        if self.stall_rate <= 0.0:
+            return False
+        lo = max(0, step - self.stall_steps + 1)
+        steps = np.arange(lo, step + 1, dtype=np.uint32)
+        return bool(chaos_hit_np(self.seed, steps, np.full_like(steps, shard),
+                                 self.stall_rate, STALL_SALT).any())
+
+    def filter_attention(self, att: np.ndarray) -> np.ndarray:
+        """Apply the fault schedule to one host-observed attention fetch.
+        Rows of lost/stalled shards are replaced by their last healthy
+        observation (frozen heartbeat); everything else passes through
+        untouched. Identity when disabled."""
+        if not self.enabled or (self.loss_rate <= 0.0
+                                and self.stall_rate <= 0.0):
+            return att
+        att = np.array(att, copy=True).reshape(-1, att.shape[-1])
+        from ..batched.supervision import ATT_STEP
+        for s in range(min(self.n_shards, att.shape[0])):
+            step = int(att[s, ATT_STEP])
+            dead = self.lost_at(s, step) is not None
+            if dead or self._stalled(s, step):
+                if s not in self._frozen:
+                    # freeze at the last observation BEFORE the fault (the
+                    # dying step's completion never reaches the host); a
+                    # shard lost before its first drain reports zeros
+                    self._frozen[s] = self._prev.get(
+                        s, np.zeros_like(att[s]))
+                att[s] = self._frozen[s]
+            else:
+                self._frozen.pop(s, None)  # stall window over: thaw
+                self._prev[s] = att[s].copy()
+        return att
 
 
 def inject(target: BatchedBehavior, seed: int, crash_rate: float = 0.0,

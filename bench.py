@@ -686,6 +686,100 @@ def bench_checkpoint(n, interval=256, windows=3, directory=None):
     }
 
 
+def bench_failover(n, steps=48, directory=None):
+    """Failover MTTR row (docs/FAILOVER.md): a MeshSentinel driven over a
+    4-device mesh with checkpoint cadence + tell WAL, then one shard is
+    force-evicted mid-run. `mttr_s` is the sentinel's own suspicion ->
+    first-post-failover-drain measurement (failover_stats). Baseline is a
+    MANUAL recovery: build a fresh ShardedBatchedSystem on the same
+    surviving devices and restore the same snapshot + journal — both
+    variants pay a fresh compile for the new shard count, so the ratio
+    prices the sentinel's quarantine/re-stage machinery, not XLA.
+    tests/test_bench_smoke.py budgets mttr <= 8x the manual restore."""
+    import shutil
+    import tempfile
+    import jax
+    import jax.numpy as jnp
+    from akka_tpu.batched import Emit, behavior
+    from akka_tpu.batched.sentinel import MeshSentinel
+    from akka_tpu.batched.sharded import ShardedBatchedSystem
+    from akka_tpu.event.flight_recorder import InMemoryFlightRecorder
+    from akka_tpu.parallel.mesh import make_mesh
+    from akka_tpu.persistence.slab_snapshot import latest_slab_path
+
+    devs = list(jax.devices())
+    if len(devs) < 2:
+        return {"ok": False,
+                "skipped": f"failover needs >= 2 devices (have {len(devs)})"}
+    ndev = 4 if len(devs) >= 4 else 2
+    # capacity must divide every survivor count (sentinel.py): a multiple
+    # of 12 survives 4 -> 3 -> 2 -> 1
+    n = max(12, (n // 12) * 12)
+    pw = 4
+
+    @behavior("bench-fo-sum", {"total": ((), jnp.float32)})
+    def summer(state, inbox, ctx):
+        return {"total": state["total"] + inbox.sum[0]}, Emit.none(1, pw)
+
+    d = directory or tempfile.mkdtemp(prefix="bench-failover-")
+    fr = InMemoryFlightRecorder()
+    sent = MeshSentinel(n, [summer], checkpoint_dir=d,
+                        devices=devs[:ndev], payload_width=pw,
+                        checkpoint_interval_steps=8, pipeline_depth=2,
+                        max_failovers=3, failover_min_backoff=0.01,
+                        failover_max_backoff=0.01, flight_recorder=fr)
+    sent.spawn(0, min(n, 64))
+    half = max(4, steps // 2)
+    for s in range(half):
+        if s % 3 == 0:
+            sent.tell(s % 8, [float(1 + s % 5), 0.0, 0.0, 0.0])
+        sent.step()
+    sent.force_evict([ndev - 1], detector="bench")
+    for _ in range(half):
+        sent.step()  # first drain after the rebuild closes the MTTR clock
+    stats = sent.sentinel_stats()
+    fo = stats["failover_stats"][-1]
+    mttr = fo.get("mttr_s")
+    completed = len(fr.of_type("failover_completed"))
+
+    # manual-recovery baseline on the identical surviving mesh; restores
+    # the sentinel's latest snapshot (the cadence prunes older ones), so
+    # both variants pay the same restore shape: snapshot load + WAL replay
+    snap = latest_slab_path(d)
+    t0 = time.perf_counter()
+    twin = ShardedBatchedSystem(n, [summer],
+                                mesh=make_mesh(devices=devs[:ndev - 1]),
+                                payload_width=pw)
+    twin.spawn_block(0, min(n, 64))
+    twin.restore(snap, journal=sent._journal)
+    twin.run(1)
+    twin.block_until_ready()
+    restore_s = time.perf_counter() - t0
+
+    sent.shutdown()
+    if directory is None:
+        shutil.rmtree(d, ignore_errors=True)
+    return {
+        "ok": mttr is not None and mttr > 0 and completed == 1,
+        "mttr_s": round(mttr, 4) if mttr is not None else None,
+        "restore_s": round(restore_s, 4),
+        "mttr_over_restore": (round(mttr / max(restore_s, 1e-9), 2)
+                              if mttr is not None else None),
+        "devices": ndev,
+        "survivors": ndev - 1,
+        "evicted_shard": ndev - 1,
+        "restored_step": fo.get("restored_step"),
+        "rebuild_s": fo.get("rebuild_s"),
+        "events": {
+            "device_suspected": len(fr.of_type("device_suspected")),
+            "device_evicted": len(fr.of_type("device_evicted")),
+            "failover_completed": completed,
+        },
+        "n": n,
+        "steps": steps,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny config, CPU-ok")
@@ -698,7 +792,7 @@ def main() -> None:
                                          "shard-api", "latency",
                                          "bridge-latency", "modes",
                                          "supervision", "checkpoint-overhead",
-                                         "spawn", "stream"],
+                                         "failover-mttr", "spawn", "stream"],
                     help="run a single config (spawn/stream are extra "
                          "JMH-analogue microbenches outside the default "
                          "10-config surface)")
@@ -895,6 +989,17 @@ def main() -> None:
                     "value": out["overhead_pct"], "unit": "pct",
                     "vs_baseline": 1.0,
                     "extra": {"checkpoint": out, **extra}}))
+            elif args.config == "failover-mttr":
+                fo_n = min(n, 1 << 12) if on_cpu else n
+                out = bench_failover(fo_n, steps=48)
+                print(json.dumps({
+                    "metric": "shard failover MTTR, forced eviction on a "
+                              "multi-device mesh (vs manual restore)"
+                              + scale_tag,
+                    "value": out.get("mttr_s") or 0,
+                    "unit": "s",
+                    "vs_baseline": out.get("mttr_over_restore") or 0.0,
+                    "extra": {"failover": out, **extra}}))
             elif args.config == "modes":
                 out = bench_modes(n, mode_steps)
                 best = max(r["msgs_per_sec"] for r in out.values()

@@ -95,6 +95,28 @@ def test_modes_smoke_ranked_beats_reference():
     assert all(recv_ok)
 
 
+def test_failover_mttr_budget():
+    """ISSUE 5 satellite: automatic failover (detection bookkeeping +
+    quarantine + rebuild + snapshot restore + WAL replay + first drain)
+    must stay within a fixed multiple of ONE manual checkpoint restore on
+    the same surviving mesh — the sentinel may not add open-ended work on
+    top of the recovery substrate it drives. Both legs pay a fresh XLA
+    compile for the new shard count, so the ratio prices the sentinel's
+    machinery, not the compiler; measured ~2x at smoke scale, and the 8x
+    budget leaves room for CI noise while a sentinel that re-steps the
+    whole horizon (or recompiles per drain) blows past any constant."""
+    out = bench.bench_failover(n=1536, steps=24)
+    assert "skipped" not in out, out  # conftest pins 8 virtual devices
+    assert out["ok"], out
+    assert out["events"]["device_evicted"] == 1, out
+    assert out["events"]["failover_completed"] == 1, out
+    assert out["mttr_s"] > 0
+    assert out["mttr_s"] <= 8.0 * out["restore_s"] + 2.0, (
+        f"failover MTTR {out['mttr_s']}s vs manual restore "
+        f"{out['restore_s']}s: blew the 8x-plus-slack budget — detection "
+        f"or rebuild is doing non-constant extra work: {out}")
+
+
 def test_bridge_pipeline_throughput_budget():
     """ISSUE 3 satellite: the depth-k attention-word pump must never be
     SLOWER than the synchronous pump round it replaced (step +

@@ -227,9 +227,40 @@ def on_tpu_found(detail: str) -> None:
                         "snapshot_bytes": ck.get("snapshot_bytes"),
                         "interval": ck.get("interval"),
                         "base_ms_per_step": ck.get("base_ms_per_step")})
+    # shard failover on-chip: force-evict one device of the real mesh and
+    # record the sentinel's MTTR (suspicion -> first post-failover drain)
+    # against a manual restore, plus the device_evicted /
+    # failover_completed event counts (docs/FAILOVER.md budgets MTTR at
+    # <= 8x one checkpoint restore)
+    run_logged("failover", [sys.executable, "bench.py", "--config",
+                            "failover-mttr", "--probe-timeout", "120"],
+               timeout_s=1800)
+    fo_out = os.path.join(REPO, "watchdog_failover.out")
+    if os.path.exists(fo_out):
+        fj = None
+        for line in open(fo_out):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    fj = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
+        fo = (fj or {}).get("extra", {}).get("failover", {})
+        if fo:
+            ev = fo.get("events", {})
+            append_log({"ts": _utcnow(), "ok": bool(fo.get("ok")),
+                        "detail": "shard failover MTTR stats",
+                        "mttr_s": fo.get("mttr_s"),
+                        "restore_s": fo.get("restore_s"),
+                        "mttr_over_restore": fo.get("mttr_over_restore"),
+                        "devices": fo.get("devices"),
+                        "survivors": fo.get("survivors"),
+                        "device_evicted": ev.get("device_evicted"),
+                        "failover_completed": ev.get("failover_completed")})
     paths = [LOG, "watchdog_bench_full.out", "watchdog_attrib.out",
              "watchdog_trace.out", "watchdog_supervision.out",
-             "watchdog_bridge.out", "watchdog_checkpoint.out"]
+             "watchdog_bridge.out", "watchdog_checkpoint.out",
+             "watchdog_failover.out"]
     if last is not None:
         paths.append("BENCH_TPU.json")
     if os.path.isdir(os.path.join(REPO, "traces/tpu_r05")):
