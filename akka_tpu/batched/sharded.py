@@ -272,6 +272,10 @@ class ShardedBatchedSystem:
             iota = jnp.arange(m, dtype=jnp.int32)
             ds32 = dest_shard.astype(jnp.int32)
             if ranked_exchange:
+                # the shard-id domain is tiny (n_shards + 2 <= 64 for every
+                # deployed mesh), so on CPU stable_ranks auto-resolves to
+                # ONE counting pass — the exchange buckets with no sort
+                # network at all (accelerators keep the 2-operand sort)
                 rank, _ = stable_ranks(ds32, n_shards, platform)
                 in_cap = out_valid & (rank < pair_cap) & (ds32 < n_shards)
                 slot = jnp.where(in_cap, ds32 * pair_cap + rank,

@@ -70,13 +70,19 @@ class Delivery(NamedTuple):
 #
 #   "auto"      — cost-model choice per platform (ranked on CPU, wide on
 #                 TPU until the attribution bench runs on-chip)
-#   "xla"       — the rank-then-scatter kernels (narrow key sort + one
+#   "xla"       — the rank-then-scatter kernels (narrow key rank + one
 #                 payload gather/scatter)
 #   "reference" — the original wide multi-operand-sort kernels, kept
 #                 bit-for-bit for parity tests and on-chip A/B
+#   "pallas"    — the ring-mailbox prototype kernel
+#                 (akka_tpu/ops/pallas_mailbox.py): per-recipient cursor
+#                 bump in arrival order, no rank pass at all. Falls back
+#                 to the ranked kernels per call when Pallas is
+#                 unimportable or the call shape/options are outside the
+#                 prototype's support matrix (see `pallas_mailbox.supported`).
 # ---------------------------------------------------------------------------
 
-DELIVERY_BACKENDS = ("auto", "xla", "reference")
+DELIVERY_BACKENDS = ("auto", "xla", "reference", "pallas")
 _delivery_backend = "auto"
 
 
@@ -97,12 +103,15 @@ def get_delivery_backend() -> str:
 
 
 def _backend_impl(backend: str | None, platform: str) -> str:
-    """Resolve a backend name to a kernel family: 'ranked' or 'wide'."""
+    """Resolve a backend name to a kernel family: 'ranked', 'wide' or
+    'pallas'."""
     backend = backend or _delivery_backend
     if backend == "reference":
         return "wide"
     if backend == "xla":
         return "ranked"
+    if backend == "pallas":
+        return "pallas"
     # auto: ranked is measured faster on CPU (docs/DELIVERY_KERNELS.md
     # crossover table); the wide kernels' TPU numbers are the only ones
     # actually measured on-chip (r4), so TPU keeps them until
@@ -168,9 +177,18 @@ def deliver(dst: jax.Array, payload: jax.Array, valid: jax.Array,
         mode = choose_reduce_kernel(dst.shape[0], n_actors,
                                     payload.shape[1],
                                     _resolve_platform(dst))
+    impl = _backend_impl(backend, _resolve_platform(dst))
+    if mode == "pallas" or (impl == "pallas" and mode != "scatter"):
+        from akka_tpu.ops import pallas_mailbox  # deferred: optional dep
+        if pallas_mailbox.supported(n_actors, payload.shape[1]):
+            return pallas_mailbox.deliver_reduce(dst, payload, valid,
+                                                 n_actors, need_max)
+        # fallback matrix (docs/DELIVERY_KERNELS.md): unsupported shape
+        # or no Pallas -> the ranked kernels, merge semantics
+        mode = "merge" if mode == "pallas" else mode
+        impl = "ranked"
     if mode == "scatter":
         return _deliver_scatter(dst, payload, valid, n_actors, need_max)
-    impl = _backend_impl(backend, _resolve_platform(dst))
     if impl == "wide":
         if mode == "merge":
             return _deliver_merge_wide(dst, payload, valid, n_actors,
@@ -187,8 +205,34 @@ def deliver(dst: jax.Array, payload: jax.Array, valid: jax.Array,
 _RANK_BLOCK = 32
 
 
+RANK_STRATEGIES = ("auto", "counting", "packed", "sort2")
+
+# Key domains this small rank in ONE counting pass (radix covers the
+# whole alphabet), where counting beats the packed sort outright on the
+# CPU grid bench — this is the sharded exchange's shard-id case.
+_COUNT_SMALL_DOMAIN = 64
+
+
+def _auto_rank_strategy(m: int, n_keys: int, platform: str) -> str:
+    """The measured strategy crossover (docs/DELIVERY_KERNELS.md grid):
+    counting wins wherever the packed strategy's int32 packing overflows
+    (1.5-3x over the sort2 fallback at 1M x 64k and 1M x 1M) and for tiny
+    key domains where it needs a single compare-reduce pass; the packed
+    sort keeps a modest edge on mid-scale legal shapes; accelerators
+    keep the vectorizing two-operand sort."""
+    if platform != "cpu":
+        return "sort2"
+    nb = -(-m // _RANK_BLOCK)
+    if (n_keys + 2) * nb >= 2 ** 31:
+        return "counting"
+    if n_keys + 2 <= _COUNT_SMALL_DOMAIN:
+        return "counting"
+    return "packed"
+
+
 def stable_ranks(key: jax.Array, n_keys: int,
-                 platform: str | None = None) -> Tuple[jax.Array, jax.Array]:
+                 platform: str | None = None,
+                 strategy: str = "auto") -> Tuple[jax.Array, jax.Array]:
     """The 'rank' phase of rank-then-scatter: for each row, the number of
     EARLIER rows with the same key (its stable arrival rank within the
     recipient), plus per-key counts. Returns (rank [M] int32,
@@ -198,24 +242,49 @@ def stable_ranks(key: jax.Array, n_keys: int,
     sort permutation inv = offsets[key] + rank — is closed-form from
     these two arrays, so no payload column ever rides a sort network.
 
-    Two strategies, chosen at trace time:
+    Three strategies, chosen at trace time (`strategy="auto"` follows
+    the measured crossover in `_auto_rank_strategy`; the explicit names
+    exist for A/B benches and parity tests):
 
-    - packed (CPU default): pack (key, block-of-B arrival index) into ONE
-      int32 and single-operand lax.sort it — XLA CPU's single-operand
-      sort measured 5.3x faster than the generic-comparator two-operand
-      (key, iota) sort. Cross-block ranks come back via vectorized binary
-      search on the sorted packs; within-block ranks via a [B, B]
-      equality triangle. Exact integers throughout.
-    - narrow sort (TPU/GPU, or shapes whose packing would overflow
-      int32): the two-operand (key, iota) sort + head-flag/cummax ranks
-      (sorts vectorize on accelerators; the searchsorted binary search
-      would serialize into ~20 dependent gathers).
+    - counting: no sort network AT ALL — `counting_ranks` buckets rows
+      by (key-digit, arrival-block), ONE exclusive cumsum over the
+      compare-reduce histogram gives every row its cross-block offset,
+      and a [B, B] equality triangle gives the within-block stable
+      rank. O(M * radix) compare/cumsum work per radix pass; large key
+      domains decompose into LSD passes so there is no int32 packing
+      limit. The CPU pick for tiny key domains (sharded exchange) and
+      for every shape where packing would overflow — including the
+      1M x 1M bench shape, where it measures 1.5-2.7x the sort2
+      fallback (docs/DELIVERY_KERNELS.md has the grid).
+    - packed (CPU pick for mid-scale key domains): pack
+      (key, block-of-B arrival index) into ONE int32 and single-operand
+      lax.sort it — measured 5.3x faster than the generic-comparator
+      two-operand sort. Cross-block ranks come back via vectorized
+      binary search on the sorted packs; within-block ranks via the
+      same [B, B] equality triangle. Requires
+      (n_keys + 2) * ceil(M/B) < 2^31; falls back to counting beyond.
+    - sort2 (TPU/GPU): the two-operand (key, iota) sort +
+      head-flag/cummax ranks (sorts vectorize on accelerators; the
+      counting strategy's data-dependent scatters and the packed
+      strategy's searchsorted binary search both serialize into
+      dependent gathers).
     """
     m = key.shape[0]
     nb = -(-m // _RANK_BLOCK)
     if platform is None:
         platform = _resolve_platform(key)
-    if platform == "cpu" and (n_keys + 2) * nb < 2 ** 31:
+    if strategy not in RANK_STRATEGIES:
+        raise ValueError(f"unknown rank strategy {strategy!r}; "
+                         f"expected one of {RANK_STRATEGIES}")
+    if strategy == "auto":
+        strategy = _auto_rank_strategy(m, n_keys, platform)
+    if strategy == "packed" and (n_keys + 2) * nb >= 2 ** 31:
+        strategy = "counting"  # int32 packing would overflow; counting
+        #                        has no such precondition and measures
+        #                        1.5-3x faster than the sort2 fallback here
+    if strategy == "counting":
+        return counting_ranks(key, n_keys)
+    if strategy == "packed":
         kp, packed = _pack_keys(key, n_keys)
         psorted = jax.lax.sort(packed)
         rank, counts = _ranks_from_packed(psorted, packed, kp, n_keys)
@@ -261,6 +330,111 @@ def _ranks_from_packed(psorted, packed, kp, n_keys: int):
     within = jnp.sum((k2[:, :, None] == k2[:, None, :]) & tri[None],
                      axis=2, dtype=jnp.int32)
     return before + within.reshape(-1), counts
+
+
+# Counting-pass tuning, from the measured per-op constants on XLA CPU
+# (docs/DELIVERY_KERNELS.md): a fused broadcast-compare-reduce runs at
+# ~0.2 ns/element while scatter costs ~85 ns/row and cumsum ~10 ns/bin
+# (log-depth passes). So a pass NEVER scatters — the histogram is a
+# compare-reduce against the digit alphabet — and the radix stays small
+# (<= 2^_COUNT_MAX_RADIX_BITS) so both the [nb, radix] compare and the
+# flat histogram cumsum stay cheap; what large radixes would save —
+# passes — costs less than the giant histograms they need.
+_COUNT_MAX_RADIX_BITS = 8
+_COUNT_MAX_BINS = 1 << 22
+
+
+def _counting_pass(digit: jax.Array, n_digits: int, nb: int,
+                   b: int) -> jax.Array:
+    """One stable counting pass: the destination position of every padded
+    row when rows are ordered by `digit` (values in [0, n_digits)) with
+    arrival order as the tiebreak. For a row in block `blk` with digit
+    `d` the destination is
+
+        (# rows with a smaller digit)             flat-cumsum, digit-major
+      + (# same-digit rows in earlier blocks)     ... same cumsum
+      + (# same-digit rows earlier in this block) [B, B] equality triangle
+
+    — the "histogram -> exclusive cumsum -> arrival-block cumsum"
+    decomposition with no sort network and no scatter: the [nb, n_digits]
+    per-block histogram is a broadcast compare against the digit alphabet
+    reduced over the block axis (XLA fuses it; ~0.2 ns/element vs ~85
+    ns/row for a scatter-add histogram), and ONE flat exclusive cumsum
+    over its digit-major transpose yields the first two terms in a
+    single gather."""
+    d2 = digit.reshape(nb, b)
+    alphabet = jnp.arange(n_digits, dtype=jnp.int32)
+    hist = jnp.sum(alphabet[None, :, None] == d2[:, None, :],
+                   axis=2, dtype=jnp.int32)                # [nb, n_digits]
+    flat = jnp.cumsum(hist.T.reshape(-1))                  # digit-major
+    excl = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                            flat[:-1].astype(jnp.int32)])
+    blk = jnp.arange(nb * b, dtype=jnp.int32) // b
+    base = excl[digit * nb + blk]
+    tri = jnp.tril(jnp.ones((b, b), jnp.bool_), k=-1)      # tri[i, j] = j < i
+    within = jnp.sum((d2[:, :, None] == d2[:, None, :]) & tri[None],
+                     axis=2, dtype=jnp.int32)
+    return base + within.reshape(-1)
+
+
+def counting_ranks(key: jax.Array, n_keys: int,
+                   max_bins: int = _COUNT_MAX_BINS
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """`stable_ranks` by bucketed counting sort — the rank phase with NO
+    sort network: O(M * radix) compare/cumsum work per pass instead of
+    an O(M log M) sort. Returns (rank [M] int32, counts [n_keys + 1]
+    int32); keys must lie in [0, n_keys].
+
+    One `_counting_pass` orders rows stably by one base-`radix` digit of
+    the key; LSD composition of `passes = ceil(log_radix(domain))`
+    passes orders them by the full key. Small key domains (the sharded
+    exchange's shard ids, small-N tests) take exactly one pass with the
+    alphabet trimmed to the domain. Between passes the permutation is
+    applied to the keys by one narrow int32 scatter (positions are a
+    bijection) and pass permutations compose by gather
+    (pos = step[pos]); those scatters are the dominant cost, so the
+    radix is chosen as the SMALLEST power of two that still achieves
+    the minimum pass count reachable under _COUNT_MAX_RADIX_BITS. Rows
+    past M pad with key n_keys + 1 so they order strictly last and
+    never perturb ranks or counts.
+
+    Unlike the packed strategy there is no int32 packing precondition:
+    every intermediate is a position (< padded M) or a histogram count
+    (<= M), so any (M, n_keys) that fits in memory is exact.
+    """
+    m = key.shape[0]
+    b = _RANK_BLOCK
+    nb = -(-m // b)
+    pad = nb * b - m
+    kp = (key if pad == 0 else
+          jnp.concatenate([key, jnp.full((pad,), n_keys + 1, jnp.int32)]))
+    n_vals = n_keys + 2              # real keys + drop bucket + pad key
+    bitlen = max((n_vals - 1).bit_length(), 1)
+    passes = -(-bitlen // _COUNT_MAX_RADIX_BITS)
+    r_bits = -(-bitlen // passes)    # smallest radix with that pass count
+    while nb * (1 << r_bits) > max_bins and r_bits > 1:
+        passes += 1
+        r_bits = -(-bitlen // passes)
+    radix = 1 << r_bits
+    pos = None                       # pos[i]: destination of original row i
+    kcur = kp                        # keys arranged in the current order
+    for p in range(passes):
+        if p + 1 < passes:
+            digit = (kcur >> (p * r_bits)) & (radix - 1)
+            nd = radix
+        else:
+            digit = kcur >> (p * r_bits)
+            nd = -(-n_vals // (radix ** p))  # top-digit alphabet only
+        step = _counting_pass(digit, nd, nb, b)
+        pos = step if pos is None else step[pos]
+        if p + 1 < passes:
+            kcur = jnp.zeros_like(kcur).at[step].set(
+                kcur, unique_indices=True, mode="promise_in_bounds")
+    counts = jnp.zeros((n_vals,), jnp.int32).at[kp].add(
+        1, mode="promise_in_bounds")[:n_keys + 1]
+    excl = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                            jnp.cumsum(counts)[:-1]])
+    return pos[:m] - excl[key], counts
 
 
 def _merged_layout_sums(inv, key, incl, masked, n_actors: int) -> jax.Array:
@@ -529,10 +703,21 @@ def deliver_slots(dst: jax.Array, mtype: jax.Array, payload: jax.Array,
 
     `backend` picks the kernel implementation (see module docstring):
     rank-then-scatter ("xla"), the original wide-sort kernel
-    ("reference"), or the platform cost model (None/"auto"). Results are
-    bit-identical either way.
+    ("reference"), the ring-mailbox prototype where its support matrix
+    allows ("pallas", integer fields bit-identical / sums arrival-order),
+    or the platform cost model (None/"auto"). Results are bit-identical
+    either way.
     """
     impl = _backend_impl(backend, _resolve_platform(dst))
+    if impl == "pallas":
+        from akka_tpu.ops import pallas_mailbox  # deferred: optional dep
+        if pallas_mailbox.supported(n_actors, payload.shape[1], slots=slots,
+                                    spill_cap=spill_cap,
+                                    slots_kind=slots_kind,
+                                    suspended=suspended):
+            return pallas_mailbox.deliver_slots_ring(
+                dst, mtype, payload, valid, n_actors, slots, need_max)
+        impl = "ranked"  # fallback matrix: docs/DELIVERY_KERNELS.md
     fn = _deliver_slots_ranked if impl == "ranked" else _deliver_slots_wide
     return fn(dst, mtype, payload, valid, n_actors, slots, need_max,
               spill_cap, slots_kind, suspended)
@@ -970,8 +1155,11 @@ def exchange_uses_ranked(platform: str, backend: str | None = None) -> bool:
     """Kernel choice for sharded.py's exchange bucketing (rank-in-group +
     scatter into the [D, C] all_to_all buffer): same seam and the same
     measured tradeoff as the slots kernel — ranked on CPU, wide on TPU
-    until on-chip attribution lands."""
-    return _backend_impl(backend, platform) == "ranked"
+    until on-chip attribution lands. The exchange's shard-id domain is
+    tiny, so the ranked path's `stable_ranks` resolves to a single
+    counting pass there (no sort at all); the pallas backend has no
+    exchange kernel and rides the ranked one."""
+    return _backend_impl(backend, platform) in ("ranked", "pallas")
 
 
 def delivery_attribution(m: int, n_actors: int, p: int = 4, slots: int = 2,
@@ -992,6 +1180,17 @@ def delivery_attribution(m: int, n_actors: int, p: int = 4, slots: int = 2,
                     boundary reads (the bit-exact consumed aggregation)
     plus wide_sort_ms, the reference kernel's (P+4)-operand sort at the
     same shape — the single number that motivates the whole scheme.
+
+    The counting-sort family adds:
+      count_rank_ms — the full `counting_ranks` pass (rank + counts,
+                      no sort network) at this shape
+      auto_rank_ms  — whatever `stable_ranks` auto-picks here (the
+                      strategy name lands in rank_strategy)
+      slots_phases  — the slots-path breakdown the ISSUE-6 satellite
+                      asks for: rank vs per-slot scatter (place) vs
+                      spill/redeliver compaction vs exact reduce, plus
+                      the end-to-end bounded step (step_ms) and the
+                      end-to-end spill-generation step (spill_step_ms).
 
     Each phase is jitted standalone and timed best-of-`repeats` with
     block_until_ready; dict values are milliseconds.
@@ -1045,6 +1244,46 @@ def delivery_attribution(m: int, n_actors: int, p: int = 4, slots: int = 2,
         flags = jnp.zeros_like(key)
         return jax.lax.sort((key, iota, mtype, flags) + fcols, num_keys=2)
 
+    def count_rank(key):
+        return counting_ranks(key, n_actors)
+
+    def auto_rank(key):
+        return stable_ranks(key, n_actors)
+
+    spill_cap = max(m // 4, 8)
+
+    def spill_phase(rank, counts_full, key, mtype, payload):
+        # the spill/redeliver compaction block of _deliver_slots_ranked
+        # (includes the shared inverse-permutation scatter it hangs off)
+        incl = jnp.cumsum(counts_full)
+        excl = jnp.concatenate([jnp.zeros((1,), jnp.int32), incl[:-1]])
+        inv = excl[key] + rank
+        s2o = jnp.zeros((m,), jnp.int32).at[inv].set(
+            jnp.arange(m, dtype=jnp.int32), unique_indices=True,
+            mode="promise_in_bounds")
+        counts = counts_full[:n_actors]
+        spc = jnp.maximum(counts - slots, 0)
+        sp_excl = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                   jnp.cumsum(spc)])
+        ss = jnp.arange(spill_cap, dtype=jnp.int32)
+        k_s = (jnp.searchsorted(sp_excl, ss, side="right").astype(jnp.int32)
+               - 1)
+        k_c = jnp.minimum(k_s, n_actors - 1)
+        r_s = ss - sp_excl[k_c] + slots
+        srow = s2o[jnp.minimum(excl[k_c] + r_s, m - 1)]
+        sp_v = ss < jnp.minimum(sp_excl[n_actors], spill_cap)
+        return (jnp.where(sp_v, k_c, -1), jnp.where(sp_v, mtype[srow], 0),
+                jnp.where(sp_v[:, None], payload[srow], 0))
+
+    ones_v = jnp.ones((m,), jnp.bool_)
+
+    def slots_step(dst, mtype, payload):
+        return deliver_slots(dst, mtype, payload, ones_v, n_actors, slots)
+
+    def spill_step(dst, mtype, payload):
+        return deliver_slots(dst, mtype, payload, ones_v, n_actors, slots,
+                             spill_cap=spill_cap)
+
     def _best_ms(fn, *args):
         jfn = jax.jit(fn)
         jax.block_until_ready(jfn(*args))  # compile outside the clock
@@ -1066,11 +1305,26 @@ def delivery_attribution(m: int, n_actors: int, p: int = 4, slots: int = 2,
                              payload),
         "reduce_ms": _best_ms(reduce_phase, rank, counts_full, key, payload),
         "wide_sort_ms": _best_ms(wide_sort, key, iota, mtype, payload),
+        "count_rank_ms": _best_ms(count_rank, key),
+        "auto_rank_ms": _best_ms(auto_rank, key),
+        "rank_strategy": _auto_rank_strategy(m, n_actors,
+                                             jax.default_backend()),
     }
     out["total_ms"] = round(out["key_sort_ms"] + out["rank_ms"]
                             + out["place_ms"] + out["reduce_ms"], 4)
+    out["slots_phases"] = {
+        "strategy": out["rank_strategy"],
+        "spill_cap": int(spill_cap),
+        "rank_ms": round(out["auto_rank_ms"], 4),
+        "place_ms": round(out["place_ms"], 4),
+        "spill_ms": round(_best_ms(spill_phase, rank, counts_full, key,
+                                   mtype, payload), 4),
+        "reduce_ms": round(out["reduce_ms"], 4),
+        "step_ms": round(_best_ms(slots_step, dst, mtype, payload), 4),
+        "spill_step_ms": round(_best_ms(spill_step, dst, mtype, payload), 4),
+    }
     for k in ("key_sort_ms", "rank_ms", "place_ms", "reduce_ms",
-              "wide_sort_ms"):
+              "wide_sort_ms", "count_rank_ms", "auto_rank_ms"):
         out[k] = round(out[k], 4)
     return out
 

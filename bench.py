@@ -32,6 +32,7 @@ VERDICT r3 #1 — the artifact must survive ANY backend state):
 import argparse
 import json
 import os
+import platform as _platform
 import subprocess
 import sys
 import time
@@ -555,6 +556,12 @@ def bench_modes(n, steps):
     # per-phase attribution at this run's inbox size (n emissions + host
     # rows), so each kernel choice is justified by a number in the artifact
     out["attribution"] = delivery_attribution(n + 8, n, p=PAYLOAD_W, slots=2)
+    if n >= (1 << 16):
+        # the 1M-row shape ROADMAP #1 names, skipped at smoke scales: the
+        # packed strategy's int32 packing overflows here, so this row is
+        # where the counting-sort rank family carries the slots path
+        out["attribution_1m"] = delivery_attribution(
+            (1 << 20) + 8, 1 << 20, p=PAYLOAD_W, slots=2, repeats=1)
     return out
 
 
@@ -813,6 +820,18 @@ def main() -> None:
     t_start = time.perf_counter()
     dev, binfo = _init_backend(args.probe_timeout, args.probe_attempts)
     extra.update(binfo)
+    # Load honesty: p50s have swung 430->640us purely with machine load, so
+    # every artifact line carries the load context it was measured under.
+    try:
+        load1, load5, load15 = os.getloadavg()
+        extra["host"] = {
+            "loadavg": [round(load1, 2), round(load5, 2), round(load15, 2)],
+            "cpus": os.cpu_count(),
+            "platform": _platform.platform(),
+        }
+    except OSError:  # getloadavg is unavailable on some platforms
+        extra["host"] = {"cpus": os.cpu_count(),
+                         "platform": _platform.platform()}
 
     n = args.actors if args.actors is not None else 1 << 20
     steps = args.steps if args.steps is not None else 64

@@ -182,6 +182,109 @@ def test_slots_fifo_oracle_ranked():
                 assert not vv[a, j]
 
 
+# ------------------------------------------- counting-sort rank family
+
+COUNT_SHAPES = [(257, 16), (1024, 64), (64, 1), (96, 96), (4096, 1000),
+                (33, 3), (333, 8)]
+
+
+def test_counting_ranks_all_strategies_identical():
+    """The counting strategy must be bit-identical to the packed sort and
+    the 2-operand fallback across the shape sweep — same ranks, same
+    counts, including the drop bucket (keys == n)."""
+    for m, n in COUNT_SHAPES:
+        key = jnp.asarray(RNG.integers(0, n + 1, size=m).astype(np.int32))
+        outs = {s: sg.stable_ranks(key, n, platform="cpu", strategy=s)
+                for s in ("counting", "packed", "sort2")}
+        r0, c0 = outs["counting"]
+        for s in ("packed", "sort2"):
+            np.testing.assert_array_equal(
+                np.asarray(r0), np.asarray(outs[s][0]),
+                err_msg=f"ranks counting vs {s} m={m} n={n}")
+            np.testing.assert_array_equal(
+                np.asarray(c0), np.asarray(outs[s][1]),
+                err_msg=f"counts counting vs {s} m={m} n={n}")
+
+
+def test_counting_ranks_empty_segments_and_all_invalid():
+    # sparse keys: the vast majority of recipients receive nothing
+    n, m = 300, 513
+    vals = np.array([0, 7, 299], np.int32)
+    key = jnp.asarray(vals[RNG.integers(0, 3, size=m)])
+    r_c, c_c = sg.stable_ranks(key, n, platform="cpu", strategy="counting")
+    r_s, c_s = sg.stable_ranks(key, n, platform="cpu", strategy="sort2")
+    np.testing.assert_array_equal(np.asarray(r_c), np.asarray(r_s))
+    np.testing.assert_array_equal(np.asarray(c_c), np.asarray(c_s))
+    assert int((np.asarray(c_c) == 0).sum()) >= n - 3
+    # every row in the drop bucket (key == n): ranks are pure arrival
+    # order, every real recipient's count is zero
+    key = jnp.asarray(np.full(160, 12, np.int32))
+    r_c, c_c = sg.stable_ranks(key, 12, platform="cpu", strategy="counting")
+    np.testing.assert_array_equal(np.asarray(r_c), np.arange(160))
+    c_c = np.asarray(c_c)
+    assert c_c[12] == 160 and not c_c[:12].any()
+
+
+def test_counting_ranks_forced_multi_pass():
+    """A tiny max_bins forces the LSD decomposition through many 1-bit
+    passes (inter-pass key permute + gather composition) — the result
+    must not change."""
+    m, n = 777, 1000
+    key = jnp.asarray(RNG.integers(0, n + 1, size=m).astype(np.int32))
+    r_1, c_1 = sg.counting_ranks(key, n)
+    r_mp, c_mp = sg.counting_ranks(key, n, max_bins=64)
+    r_p, c_p = sg.stable_ranks(key, n, platform="cpu", strategy="packed")
+    np.testing.assert_array_equal(np.asarray(r_1), np.asarray(r_mp))
+    np.testing.assert_array_equal(np.asarray(c_1), np.asarray(c_mp))
+    np.testing.assert_array_equal(np.asarray(r_1), np.asarray(r_p))
+    np.testing.assert_array_equal(np.asarray(c_1), np.asarray(c_p))
+
+
+def test_counting_ranks_packing_overflow_boundary():
+    """(n_keys + 2) * ceil(M/B) >= 2^31: the packed strategy's int32
+    packing is illegal here, auto must route to counting, an explicit
+    "packed" request must be rerouted too, and the ranks must still match
+    the 2-operand fallback bit-for-bit."""
+    m, n = (1 << 16) + 33, 1 << 20
+    assert sg._auto_rank_strategy(m, n, "cpu") == "counting"
+    key = jnp.asarray(RNG.integers(0, n + 1, size=m).astype(np.int32))
+    r_a, c_a = sg.stable_ranks(key, n, platform="cpu")          # auto
+    r_p, c_p = sg.stable_ranks(key, n, platform="cpu",
+                               strategy="packed")               # rerouted
+    r_s, c_s = sg.stable_ranks(key, n, platform="cpu", strategy="sort2")
+    np.testing.assert_array_equal(np.asarray(r_a), np.asarray(r_s))
+    np.testing.assert_array_equal(np.asarray(c_a), np.asarray(c_s))
+    np.testing.assert_array_equal(np.asarray(r_p), np.asarray(r_s))
+    np.testing.assert_array_equal(np.asarray(c_p), np.asarray(c_s))
+
+
+def test_delivery_parity_with_counting_ranks(monkeypatch):
+    """Full deliver / deliver_slots with the rank phase FORCED to
+    counting stays bit-identical to the wide reference kernels on both
+    delivery paths (fresh shapes, so no cached packed trace is reused)."""
+    monkeypatch.setattr(sg, "_auto_rank_strategy",
+                        lambda m, n, platform: "counting")
+    dst, payload, ok = _case(517, 29, 3)
+    mtype = jnp.asarray(RNG.integers(1, 5, size=517).astype(np.int32))
+    for style in ("merge", "sort"):
+        ref = sg.deliver(dst, payload, ok, 29, need_max=True, mode=style,
+                         backend="reference")
+        new = sg.deliver(dst, payload, ok, 29, need_max=True, mode=style,
+                         backend="xla")
+        _assert_fields_identical(ref, new, f"counting reduce {style}")
+    ref = sg.deliver_slots(dst, mtype, payload, ok, 29, 2, need_max=True,
+                           spill_cap=8, backend="reference")
+    new = sg.deliver_slots(dst, mtype, payload, ok, 29, 2, need_max=True,
+                           spill_cap=8, backend="xla")
+    _assert_fields_identical(ref, new, "counting slots")
+    # all-invalid through the full delivery with counting ranks
+    dead = jnp.asarray(np.zeros(517, bool))
+    ref = sg.deliver_slots(dst, mtype, payload, dead, 29, 2,
+                           backend="reference")
+    new = sg.deliver_slots(dst, mtype, payload, dead, 29, 2, backend="xla")
+    _assert_fields_identical(ref, new, "counting slots all-invalid")
+
+
 def test_backend_seam_roundtrip():
     """set/get_delivery_backend steer the dispatcher; unknown names are
     rejected loudly (a typo must not silently fall back)."""
