@@ -139,6 +139,13 @@ class ActorSystem:
         from ..event.flight_recorder import from_config as _fr_from_config
         self.flight_recorder = _fr_from_config(cfg)
 
+        # metrics registry: the other half of the telemetry plane
+        # (event/metrics.py) — None unless akka.metrics.enabled; the
+        # tpu-batched dispatcher wires its device slab and stats
+        # collectors into it (docs/OBSERVABILITY.md)
+        from ..event.metrics import from_config as _metrics_from_config
+        self.metrics_registry = _metrics_from_config(cfg)
+
         # multi-host data plane: opt-in jax.distributed bootstrap (DCN) so
         # device meshes span every process in the cluster (SURVEY.md §2.3
         # TPU-native equivalent; akka.jax-distributed.* config)
@@ -275,6 +282,8 @@ class ActorSystem:
         self.dispatchers.shutdown()
         self.scheduler.shutdown()
         self.flight_recorder.close()
+        if self.metrics_registry is not None:
+            self.metrics_registry.close()
         self._terminated.set()
         for cb in self._termination_callbacks:
             try:

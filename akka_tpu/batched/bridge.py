@@ -33,6 +33,7 @@ AskSupport.scala:476)."""
 
 from __future__ import annotations
 
+import math
 import os
 import threading
 import time
@@ -52,6 +53,7 @@ from ..pattern.circuit_breaker import (CircuitBreaker,
                                        CircuitBreakerOpenException)
 from .behavior import BatchedBehavior, Emit, behavior as behavior_deco
 from .core import BatchedSystem
+from .metrics_slab import ASK_ARM_COL
 from .supervision import ATT_FAILED_BIT, ATT_FLAGS, ATT_LATCH_BIT
 
 I32 = jnp.int32
@@ -168,7 +170,9 @@ class BatchedRuntimeHandle:
                  sentinel_threshold: float = 8.0,
                  sentinel_heartbeat_interval: float = 0.1,
                  sentinel_acceptable_pause: float = 3.0,
-                 sentinel_max_failovers: int = 3):
+                 sentinel_max_failovers: int = 3,
+                 metrics_enabled: bool = False,
+                 metrics_registry=None):
         self.capacity = capacity
         self.payload_width = payload_width
         self.out_degree = out_degree
@@ -264,6 +268,13 @@ class BatchedRuntimeHandle:
         # per-iteration host cost of the stepping driver (enqueue + any
         # forced drains), for the bench's dispatch-component percentiles
         self._dispatch_s: deque = deque(maxlen=4096)
+        # sorted-snapshot cache for the dispatch percentiles: the metrics
+        # registry polls pipeline_stats() on every expose/scrape, and
+        # re-sorting the full 4096-sample window each pull is pure waste
+        # when no step ran in between. The append counter is the
+        # invalidation token (maxlen evictions only happen on append).
+        self._dispatch_seq = 0
+        self._dispatch_sorted: Tuple[int, List[float]] = (-1, [])
 
         # auto-checkpoint cadence (ISSUE 4 tentpole #4): every
         # checkpoint_interval_steps dispatched steps the pump takes a
@@ -287,6 +298,24 @@ class BatchedRuntimeHandle:
         self._ckpt_stats = {"checkpoints": 0, "failures": 0,
                             "last_step": 0, "last_duration_s": 0.0,
                             "last_size_bytes": 0, "last_path": None}
+
+        # unified telemetry plane (event/metrics.py + batched/metrics_slab):
+        # metrics_enabled compiles the device slab into the step; the
+        # registry absorbs the *_stats() dicts as collectors and ingests
+        # the slab at the pump's busy->idle edge and the checkpoint
+        # barrier. A caller-supplied registry is shared (the dispatcher
+        # owns its sinks); otherwise the handle owns one and closes it.
+        self.metrics_enabled = bool(metrics_enabled)
+        self._owns_registry = metrics_registry is None and self.metrics_enabled
+        if metrics_registry is None and self.metrics_enabled:
+            from ..event.metrics import MetricsRegistry
+            metrics_registry = MetricsRegistry()
+        self.metrics_registry = metrics_registry
+        if self.metrics_registry is not None:
+            reg = self.metrics_registry
+            reg.register_collector("pipeline", self.pipeline_stats)
+            reg.register_collector("checkpoint", self.checkpoint_stats)
+            reg.register_collector("sentinel", self._sentinel_metrics)
 
     # -------------------------------------------------------------- behaviors
     def _behavior_index(self, b: BatchedBehavior) -> int:
@@ -409,7 +438,8 @@ class BatchedRuntimeHandle:
             # the promise-latch column feeds ATT_LATCH_BIT of the
             # attention word: the pump only pays the wide promise-block
             # readback when some row actually latched a reply
-            attention_latch_col=self.PROMISE_REPLIED)
+            attention_latch_col=self.PROMISE_REPLIED,
+            metrics_enabled=self.metrics_enabled)
         if self.event_stream is not None:
             rt.on_dropped = self._publish_dropped
             rt.on_dead_letter = self._publish_dead_letters
@@ -460,7 +490,8 @@ class BatchedRuntimeHandle:
             host_inbox=self.host_inbox, payload_dtype=self.payload_dtype,
             mailbox_slots=self.mailbox_slots,
             delivery_backend=self.delivery_backend,
-            attention_latch_col=self.PROMISE_REPLIED)
+            attention_latch_col=self.PROMISE_REPLIED,
+            metrics_enabled=self.metrics_enabled)
         if self.event_stream is not None:
             rt.on_dropped = self._publish_dropped
         rt.flight_recorder = self.flight_recorder
@@ -487,6 +518,13 @@ class BatchedRuntimeHandle:
         rt.sup_counts = old.sup_counts
         rt._sup_reported = old._sup_reported
         rt.attention = old.attention
+        # the metric slab and sojourn stamps survive too — cumulative
+        # telemetry, exactly like sup_counts; the drain epoch bookmark
+        # rides so the swap doesn't force a spurious re-ingest
+        rt.metrics = old.metrics
+        rt.metrics_epoch = old.metrics_epoch
+        rt.inbox_enq = old.inbox_enq
+        rt._metrics_seen_epoch = old._metrics_seen_epoch
         rt._next_row = old._next_row
         rt._free_rows = list(old._free_rows)
         # tells staged since the last step must survive the swap (the
@@ -580,6 +618,12 @@ class BatchedRuntimeHandle:
             rt = self._runtime
             rt.state[self.PROMISE_REPLIED] = \
                 rt.state[self.PROMISE_REPLIED].at[prow].set(False)
+            if self.metrics_enabled:
+                # arm the ask-latency clock: the slab histograms
+                # (latch-flip step - this stamp) when the reply lands
+                # (metrics_slab HIST_ASK)
+                rt.state[ASK_ARM_COL] = \
+                    rt.state[ASK_ARM_COL].at[prow].set(rt._host_step)
         mtype, payload = c.encode(message, reply_to=prow)
         with self._lock:
             self._waiters[prow] = (fut, c)
@@ -854,6 +898,11 @@ class BatchedRuntimeHandle:
                 # _has_pending and loops back to the busy path
                 self._drain_one(inflight)
                 continue
+            # busy->idle edge: the device-slab drain point (epoch-gated —
+            # one scalar fetch when nothing accumulated) and the pipeline
+            # delta report share it, so the depth-k pipeline never pays a
+            # mid-flight sync for telemetry
+            self.drain_metrics()
             fr = self.flight_recorder
             if fr is not None and fr.enabled:
                 self._report_pipeline(fr)  # busy->idle edge: emit deltas
@@ -893,8 +942,12 @@ class BatchedRuntimeHandle:
             while len(inflight) >= d:
                 self._drain_one(inflight)
             self._dispatch_s.append(time.perf_counter() - t0)
+            self._dispatch_seq += 1
         while inflight:
             self._drain_one(inflight)
+        # explicit stepping is synchronous at return — a quiescent point,
+        # so it doubles as a drain point like the pump's busy->idle edge
+        self.drain_metrics()
 
     # ------------------------------------------------- checkpoint / recovery
     def checkpoint(self, directory: Optional[str] = None) -> str:
@@ -938,6 +991,9 @@ class BatchedRuntimeHandle:
         fr = self.flight_recorder
         if fr is not None and fr.enabled:
             fr.device_checkpoint("batched", step, elapsed, int(size), path)
+        # checkpoint barrier = the other slab drain point: the pipeline is
+        # already quiesced, so the full fetch costs no extra sync
+        self.drain_metrics()
         return path
 
     def restore(self, path: Optional[str] = None) -> int:
@@ -1035,12 +1091,18 @@ class BatchedRuntimeHandle:
         how many drains paid the wide promise readback vs host-only
         deadline checks, and dispatch-component percentiles (per-iteration
         host cost of the stepping driver: enqueue + forced drains)."""
-        d = sorted(self._dispatch_s)
+        seq, d = self._dispatch_sorted
+        if seq != self._dispatch_seq:
+            d = sorted(self._dispatch_s)
+            self._dispatch_sorted = (self._dispatch_seq, d)
 
         def pct(q: float) -> float:
+            # nearest-rank: rank ceil(q*n) (1-based), so p50 of [a, b] is
+            # a, not b — the old min(int(q*n), n-1) indexed one PAST the
+            # nearest rank whenever q*n landed on an integer
             if not d:
                 return 0.0
-            return round(d[min(int(q * len(d)), len(d) - 1)] * 1e6, 1)
+            return round(d[max(math.ceil(q * len(d)) - 1, 0)] * 1e6, 1)
 
         return {"depth": self.pipeline_depth,
                 "steps": self._stat_steps,
@@ -1057,6 +1119,41 @@ class BatchedRuntimeHandle:
         return {"drains": self._sentinel.drains,
                 "suspected": sorted(self._sentinel.suspected()),
                 "max_failovers": self.sentinel_max_failovers}
+
+    def _sentinel_metrics(self) -> Dict[str, Any]:
+        """sentinel_stats plus the numeric gauges the registry surfaces:
+        suspicion count and the phi value of shard 0 (this handle's only
+        shard) — the detector's continuous health signal, not just the
+        tripped/untripped bit."""
+        st = self.sentinel_stats()
+        st["suspected_count"] = len(st.pop("suspected", ()))
+        try:
+            st["phi"] = float(self._sentinel.phi(0))
+        except Exception:  # noqa: BLE001 — phi before first heartbeat
+            st["phi"] = 0.0
+        return st
+
+    def drain_metrics(self) -> None:
+        """Conditional device-slab drain into the registry. The quiet path
+        costs ONE scalar fetch (the epoch word); a changed epoch pays the
+        [N_HIST, N_BUCKETS] slab fetch and re-ingests. Host stats ride
+        along via the registered collectors at exposition time, so this
+        only moves device data. Called at the pump's busy->idle edge, the
+        checkpoint barrier, and explicit step() returns."""
+        reg = self.metrics_registry
+        if reg is None or not self.metrics_enabled:
+            return
+        with self._step_lock:  # a drain must not race a fresh enqueue
+            rt = self._runtime
+            if rt is None:
+                return
+            drained = rt.drain_metrics()
+            host_step = rt._host_step
+        if drained is not None:
+            step, lanes = drained
+            reg.ingest_device_slab(lanes, step)
+        else:
+            reg.set_step(host_step)
 
     def _report_pipeline(self, fr) -> None:
         """Emit pipeline counter DELTAS as a device_pipeline event (same
@@ -1130,6 +1227,12 @@ class BatchedRuntimeHandle:
         fr = self.flight_recorder
         if fr is not None and fr.enabled:
             self._report_pipeline(fr)  # flush the final pipeline deltas
+        try:
+            self.drain_metrics()  # final slab frame before sinks close
+        except Exception:  # noqa: BLE001 — shutdown must not raise
+            pass
+        if self._owns_registry and self.metrics_registry is not None:
+            self.metrics_registry.close()
         if self._journal is not None:
             self._journal.close()
 

@@ -47,6 +47,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .behavior import BatchedBehavior
+from .metrics_slab import (ASK_ARM_COL, ASK_ARM_SPEC, accumulate_step,
+                           empty_slab, slab_dict)
 from .step import StepCore
 from .supervision import (ATT_WORDS, N_COUNTERS, SUP_COLUMNS, counts_dict,
                           decode_attention, reserved_fill)
@@ -105,7 +107,8 @@ class BatchedSystem:
                  native_staging: Optional[bool] = None,
                  spill_capacity: Optional[int] = None,
                  delivery_backend: Optional[str] = None,
-                 attention_latch_col: Optional[str] = None):
+                 attention_latch_col: Optional[str] = None,
+                 metrics_enabled: bool = False):
         if not behaviors:
             raise ValueError("at least one behavior required")
         self.capacity = int(capacity)
@@ -153,6 +156,12 @@ class BatchedSystem:
                 self.state_spec.setdefault(col, spec)
         elif any(getattr(b, "nonfinite_guard", False) for b in behaviors):
             self.state_spec.setdefault("_failed", SUP_COLUMNS["_failed"])
+        # in-graph metric slab (batched/metrics_slab.py): the ask-latency
+        # lane needs the arm-step column the bridge stamps in ask() — only
+        # meaningful when a promise latch column exists at all
+        self.metrics_on = bool(metrics_enabled)
+        if self.metrics_on and attention_latch_col is not None:
+            self.state_spec.setdefault(ASK_ARM_COL, ASK_ARM_SPEC)
 
         n = self.capacity
         self.state: Dict[str, jax.Array] = {
@@ -176,6 +185,16 @@ class BatchedSystem:
         # step — the depth-k pipelined drivers sync on THIS handle and
         # read the flag bits instead of wide per-column device_gets
         self.attention = jnp.zeros((ATT_WORDS,), jnp.int32)
+        # in-graph metric slab ([N_HIST, N_BUCKETS] int32 histograms,
+        # batched/metrics_slab.py) riding the carry like sup_counts, and
+        # its epoch word — a non-donated scalar output (sum of the slab,
+        # the attention-word trick) the host polls to decide whether a
+        # full slab drain is worth fetching. The slab rides the carry even
+        # when metrics are off (static carry structure; XLA aliases the
+        # untouched buffer through), but all stamping/accumulation is
+        # gated out at TRACE time by metrics_on.
+        self.metrics = empty_slab()
+        self.metrics_epoch = jnp.asarray(0, jnp.int32)
 
         # inbox layout: [spill_cap | n*K emissions | host_inbox] — spill
         # first so redelivered (older) mail outranks fresh emissions in the
@@ -185,6 +204,12 @@ class BatchedSystem:
         self.inbox_type = jnp.zeros((m,), dtype=jnp.int32)
         self.inbox_payload = jnp.zeros((m, self.payload_width), dtype=payload_dtype)
         self.inbox_valid = jnp.zeros((m,), dtype=jnp.bool_)
+        # enqueue-step column for the sojourn-age lane: the step a row was
+        # written (emissions: the writing step; host flush: the flushing
+        # dispatch; spill re-injection re-stamps). (0,) when metrics are
+        # off — the column costs nothing unless measured.
+        self.inbox_enq = jnp.zeros((m,) if self.metrics_on else (0,),
+                                   jnp.int32)
 
         self._next_row = 0
         self._free_rows: List[int] = []
@@ -257,12 +282,12 @@ class BatchedSystem:
             (self.host_inbox, self.payload_width), self._np_payload_dtype)
         self._flush_valid = np.zeros((self.host_inbox,), np.bool_)
         self._flush_jit = jax.jit(self._flush_impl,
-                                  donate_argnums=(0, 1, 2, 3))
+                                  donate_argnums=(0, 1, 2, 3, 4))
         # fused flush+step: ONE program dispatch when host tells are staged
         # (the tell->receive latency path pays per-dispatch overhead twice
         # otherwise — on a tunneled backend that is 2x the RTT)
         self._flush_step_jit = jax.jit(self._flush_step_impl,
-                                       donate_argnums=tuple(range(9)))
+                                       donate_argnums=tuple(range(11)))
 
         self._core = StepCore(self.behaviors, n_local=self.capacity,
                               payload_width=self.payload_width,
@@ -273,14 +298,17 @@ class BatchedSystem:
                               spill_cap=self.spill_cap,
                               delivery_backend=delivery_backend,
                               attention_latch_col=attention_latch_col)
+        # host cache of the last INGESTED metrics epoch (the registry's
+        # drain bookkeeping rides here so rebuilds carry it over)
+        self._metrics_seen_epoch = 0
 
         # topology tables ride as runtime arguments (pytree): closure
         # constants would be baked into the HLO (multi-MB programs break
         # remote compile). Kind/scalars are trace-time constants.
         self._topo_arrays = topology.runtime_arrays() if topology is not None else ()
-        donate = tuple(range(9))  # everything but step_count
+        donate = tuple(range(11))  # everything but step_count
         self._step_jit = jax.jit(self._step_impl, donate_argnums=donate)
-        self._run_jit = jax.jit(self._run_impl, static_argnums=(10,),
+        self._run_jit = jax.jit(self._run_impl, static_argnums=(12,),
                                 donate_argnums=donate)
 
     # ------------------------------------------------------------- lifecycle
@@ -482,38 +510,47 @@ class BatchedSystem:
         self.inbox_valid = self.inbox_valid.at[:k].set(True)
 
     def _flush_impl(self, inbox_dst, inbox_type, inbox_payload, inbox_valid,
-                    dsts, mts, pls, valid):
+                    inbox_enq, dsts, mts, pls, valid, step_count):
         """One static-shape program: overwrite the host region of the inbox.
-        [host_inbox]-shaped args regardless of how many tells are staged."""
+        [host_inbox]-shaped args regardless of how many tells are staged.
+        With metrics on, flushed rows stamp the enqueue-step column with
+        the flushing dispatch's counter — delivered by that same dispatch
+        (fused flush+step) their sojourn age reads 0."""
         base = self.spill_cap + self.capacity * self.out_degree
         upd = jax.lax.dynamic_update_slice
+        if self.metrics_on:
+            stamp = jnp.broadcast_to(jnp.asarray(step_count, jnp.int32),
+                                     (self.host_inbox,))
+            inbox_enq = upd(inbox_enq, stamp, (base,))
         return (upd(inbox_dst, dsts, (base,)),
                 upd(inbox_type, mts, (base,)),
                 upd(inbox_payload, pls, (base, 0)),
-                upd(inbox_valid, valid, (base,)))
+                upd(inbox_valid, valid, (base,)),
+                inbox_enq)
 
     def _run_flush(self, k: int) -> None:
         """Dispatch the flush program over pads filled by _drain_to_pad."""
         (self.inbox_dst, self.inbox_type, self.inbox_payload,
-         self.inbox_valid) = self._flush_jit(
+         self.inbox_valid, self.inbox_enq) = self._flush_jit(
             self.inbox_dst, self.inbox_type, self.inbox_payload,
-            self.inbox_valid,
+            self.inbox_valid, self.inbox_enq,
             jnp.asarray(self._flush_dst), jnp.asarray(self._flush_type),
             jnp.asarray(self._flush_payload, self.payload_dtype),
-            jnp.asarray(self._flush_valid))
+            jnp.asarray(self._flush_valid), self.step_count)
 
     def _flush_step_impl(self, state, behavior_id, alive, inbox_dst,
-                         inbox_type, inbox_payload, inbox_valid,
-                         mail_dropped, sup_counts, step_count, dsts, mts,
-                         pls, valid, topo_arrays=()):
+                         inbox_type, inbox_payload, inbox_valid, inbox_enq,
+                         mail_dropped, sup_counts, metrics, step_count,
+                         dsts, mts, pls, valid, topo_arrays=()):
         """flush + step as ONE program (the latency hot path)."""
-        inbox_dst, inbox_type, inbox_payload, inbox_valid = self._flush_impl(
-            inbox_dst, inbox_type, inbox_payload, inbox_valid,
-            dsts, mts, pls, valid)
+        (inbox_dst, inbox_type, inbox_payload, inbox_valid,
+         inbox_enq) = self._flush_impl(
+            inbox_dst, inbox_type, inbox_payload, inbox_valid, inbox_enq,
+            dsts, mts, pls, valid, step_count)
         return self._step_impl(state, behavior_id, alive, inbox_dst,
                                inbox_type, inbox_payload, inbox_valid,
-                               mail_dropped, sup_counts, step_count,
-                               topo_arrays)
+                               inbox_enq, mail_dropped, sup_counts, metrics,
+                               step_count, topo_arrays)
 
     def _drain_to_pad(self) -> int:
         """Drain staged host tells (native stager or Python list) into the
@@ -562,15 +599,22 @@ class BatchedSystem:
 
     # ------------------------------------------------------------------ step
     def _step_impl(self, state, behavior_id, alive, inbox_dst, inbox_type,
-                   inbox_payload, inbox_valid, mail_dropped, sup_counts,
-                   step_count, topo_arrays=()):
+                   inbox_payload, inbox_valid, inbox_enq, mail_dropped,
+                   sup_counts, metrics, step_count, topo_arrays=()):
         n = self.capacity
         sc = self.spill_cap
         nk = n * self.out_degree
+        old_alive = alive
         (new_state, behavior_id, alive, emits, dropped, spill,
-         sup_delta) = self._core.run_local(
+         sup_delta, dcount) = self._core.run_local(
             state, behavior_id, alive, inbox_dst, inbox_type, inbox_payload,
             inbox_valid, step_count, topo_arrays)
+        new_metrics = metrics
+        if self.metrics_on:
+            new_metrics = accumulate_step(
+                metrics, state, new_state, old_alive, dcount, inbox_valid,
+                inbox_enq, step_count,
+                latch_col=self._core.attention_latch_col)
 
         # write emissions in place over the donated inbox buffers (rows
         # [sc, sc+n*K) are exactly the emission slots; retained spill goes
@@ -594,6 +638,19 @@ class BatchedSystem:
                                 (sc, 0)).at[sc + nk:].set(0)
         new_inbox_valid = upd(inbox_valid, out_valid,
                               (sc,)).at[sc + nk:].set(False)
+        new_inbox_enq = inbox_enq
+        if self.metrics_on:
+            # emissions written this step carry this step's counter (their
+            # delivery next step reads age 1); retained spill is RE-stamped
+            # at injection, so sojourn ages count steps since last
+            # (re)stamp — per-source semantics, docs/OBSERVABILITY.md
+            stamp = jnp.broadcast_to(jnp.asarray(step_count, jnp.int32),
+                                     (nk,))
+            new_inbox_enq = upd(inbox_enq, stamp,
+                                (sc,)).at[sc + nk:].set(0)
+            if sc > 0:
+                new_inbox_enq = new_inbox_enq.at[:sc].set(
+                    jnp.asarray(step_count, jnp.int32))
         if spill is not None:  # spill is None iff sc == 0
             sp_dst, sp_type, sp_pl, sp_v = spill
             new_inbox_dst = new_inbox_dst.at[:sc].set(sp_dst)
@@ -602,44 +659,53 @@ class BatchedSystem:
             new_inbox_valid = new_inbox_valid.at[:sc].set(sp_v)
         new_dropped = mail_dropped + dropped
         new_counts = sup_counts + sup_delta
-        # the attention word is a pure function of the new carry, appended
-        # as an 11th output OUTSIDE the donation set (indices 0-8): its
-        # buffer is never aliased, so device_get on it is a safe sync
+        # the attention word and the metrics epoch are pure functions of
+        # the new carry, appended as outputs OUTSIDE the donation set
+        # (indices 0-10): their buffers are never aliased, so device_get
+        # on them is a safe sync
         attention = self._core.attention_word(new_state, new_dropped,
                                               new_counts, step_count + 1)
+        epoch = (jnp.sum(new_metrics).astype(jnp.int32) if self.metrics_on
+                 else jnp.asarray(0, jnp.int32))
         return (new_state, behavior_id, alive, new_inbox_dst, new_inbox_type,
-                new_inbox_payload, new_inbox_valid, new_dropped,
-                new_counts, step_count + 1, attention)
+                new_inbox_payload, new_inbox_valid, new_inbox_enq,
+                new_dropped, new_counts, new_metrics, step_count + 1,
+                attention, epoch)
 
     def _run_impl(self, state, behavior_id, alive, inbox_dst, inbox_type,
-                  inbox_payload, inbox_valid, mail_dropped, sup_counts,
-                  step_count, n_steps: int, topo_arrays=()):
+                  inbox_payload, inbox_valid, inbox_enq, mail_dropped,
+                  sup_counts, metrics, step_count, n_steps: int,
+                  topo_arrays=()):
         def body(carry, _):
-            # drop the per-step attention word inside the scan: every field
-            # is carry-derived (flags = current state, counters cumulative),
-            # so recomputing it once from the final carry loses nothing
-            return self._step_impl(*carry, topo_arrays)[:10], None
+            # drop the per-step attention word and metrics epoch inside the
+            # scan: every field is carry-derived (flags = current state,
+            # counters and the slab cumulative), so recomputing them once
+            # from the final carry loses nothing
+            return self._step_impl(*carry, topo_arrays)[:12], None
 
         carry = (state, behavior_id, alive, inbox_dst, inbox_type,
-                 inbox_payload, inbox_valid, mail_dropped, sup_counts,
-                 step_count)
+                 inbox_payload, inbox_valid, inbox_enq, mail_dropped,
+                 sup_counts, metrics, step_count)
         carry, _ = jax.lax.scan(body, carry, None, length=n_steps)
-        attention = self._core.attention_word(carry[0], carry[7], carry[8],
-                                              carry[9])
-        return carry + (attention,)
+        attention = self._core.attention_word(carry[0], carry[8], carry[9],
+                                              carry[11])
+        epoch = (jnp.sum(carry[10]).astype(jnp.int32) if self.metrics_on
+                 else jnp.asarray(0, jnp.int32))
+        return carry + (attention, epoch)
 
     def _carry(self):
         return (self.state, self.behavior_id, self.alive, self.inbox_dst,
                 self.inbox_type, self.inbox_payload, self.inbox_valid,
-                self.mail_dropped, self.sup_counts, self.step_count)
+                self.inbox_enq, self.mail_dropped, self.sup_counts,
+                self.metrics, self.step_count)
 
     def _set_carry(self, out) -> None:
-        # `out` is a step/run output: the 10 carry slots plus the
-        # non-donated attention word
+        # `out` is a step/run output: the 12 carry slots plus the
+        # non-donated attention word and metrics epoch
         (self.state, self.behavior_id, self.alive, self.inbox_dst,
          self.inbox_type, self.inbox_payload, self.inbox_valid,
-         self.mail_dropped, self.sup_counts, self.step_count,
-         self.attention) = out
+         self.inbox_enq, self.mail_dropped, self.sup_counts, self.metrics,
+         self.step_count, self.attention, self.metrics_epoch) = out
 
     def step(self) -> None:
         """One delivery+update step. Staged host tells ride INSIDE the same
@@ -728,9 +794,10 @@ class BatchedSystem:
             jnp.zeros((m,), jnp.int32), jnp.zeros((m,), jnp.int32),
             jnp.zeros((m, self.payload_width), self.payload_dtype),
             jnp.zeros((m,), jnp.bool_),
+            jnp.zeros_like(self.inbox_enq),
             jnp.asarray(self._flush_dst), jnp.asarray(self._flush_type),
             jnp.asarray(self._flush_payload, self.payload_dtype),
-            jnp.asarray(self._flush_valid))
+            jnp.asarray(self._flush_valid), jnp.asarray(0, jnp.int32))
         jax.tree.map(lambda a: a.delete() if hasattr(a, "delete") else None,
                      out)
         clone = jax.tree.map(jnp.zeros_like, self._carry())
@@ -768,6 +835,35 @@ class BatchedSystem:
                 self._overflow_reported = (mail, exch)
         return word
 
+    # ---------------------------------------------------- in-graph metrics
+    def metrics_epoch_value(self) -> int:
+        """One tiny device_get of the non-donated metrics-epoch word —
+        like read_attention it doubles as a sync for the newest dispatched
+        step. Cheap enough for the pump's busy→idle edge to poll."""
+        return int(np.asarray(jax.device_get(self.metrics_epoch)))
+
+    def read_metrics(self) -> Dict[str, np.ndarray]:
+        """Host copy of the metric slab as named [N_BUCKETS] int64 lanes
+        (metrics_slab.HIST_NAMES; per-shard slab rows summed). Implicitly
+        drains the dispatch pipeline (see read_state)."""
+        self.block_until_ready()
+        return slab_dict(self.metrics)
+
+    def drain_metrics(self):
+        """Epoch-gated slab drain for the bridge/registry: returns
+        (step, {name: [N_BUCKETS] int64}) when the slab grew since the
+        last drain, else None. The quiet path costs ONE scalar device_get
+        (the epoch word) — no slab fetch, no extra sync beyond the one
+        the caller's drain point already implies."""
+        if not self.metrics_on:
+            return None
+        epoch = self.metrics_epoch_value()
+        if epoch == self._metrics_seen_epoch:
+            return None
+        self._metrics_seen_epoch = epoch
+        step = int(np.asarray(jax.device_get(self.step_count)))
+        return step, slab_dict(self.metrics)
+
     # ------------------------------------------------- checkpoint / recovery
     def checkpoint(self, directory: str, keep: Optional[int] = None) -> str:
         """Checkpoint barrier: drain every in-flight dispatch to a
@@ -802,6 +898,13 @@ class BatchedSystem:
         from ..persistence.tell_journal import replay_journal
         restore_slabs(self, path)
         self._host_step = int(np.asarray(jax.device_get(self.step_count)))
+        # re-arm the drain gate against the RESTORED slab: seen resets to 0
+        # and the epoch handle (normally a step output) is recomputed from
+        # the slab, so a restored non-empty slab is drainable immediately,
+        # not only after the first post-restore run
+        self.metrics_epoch = jnp.asarray(
+            int(np.asarray(jax.device_get(self.metrics)).sum()), jnp.int32)
+        self._metrics_seen_epoch = 0
         if self._stager is not None:
             self._stager.drain()
         with self._lock:

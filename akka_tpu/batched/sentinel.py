@@ -219,7 +219,9 @@ class MeshSentinel:
                  promise_rows: int = 0,
                  clock=_time.monotonic,
                  flight_recorder=None,
-                 injector=None):
+                 injector=None,
+                 metrics_enabled: bool = False,
+                 metrics_registry=None):
         if pipeline_depth < 1 or min_pipeline_depth < 1:
             raise ValueError("pipeline depths must be >= 1")
         self._capacity_arg = int(capacity)
@@ -244,6 +246,13 @@ class MeshSentinel:
         self.clock = clock
         self.flight_recorder = flight_recorder
         self.injector = injector
+        # telemetry plane: slab compiled into the sharded step when on;
+        # phi/suspicion surface as gauges through the registered collector
+        self.metrics_enabled = bool(metrics_enabled)
+        self.metrics_registry = metrics_registry
+        if self.metrics_registry is not None:
+            self.metrics_registry.register_collector(
+                "mesh_sentinel", self._sentinel_metrics)
         self._fo_min_backoff = float(failover_min_backoff)
         self._fo_max_backoff = float(failover_max_backoff)
 
@@ -328,7 +337,8 @@ class MeshSentinel:
             mailbox_slots=self.mailbox_slots,
             delivery_backend=self.delivery_backend,
             attention_latch_col=(self.PROMISE_REPLIED
-                                 if self.promise_rows_n > 0 else None))
+                                 if self.promise_rows_n > 0 else None),
+            metrics_enabled=self.metrics_enabled)
         sys_.flight_recorder = self.flight_recorder
         sys_.tell_journal = self._journal
         for b_idx, n, init in self._spawns:
@@ -662,6 +672,7 @@ class MeshSentinel:
             self.flight_recorder.device_checkpoint(
                 "sentinel", int(self.system._host_step),
                 _time.perf_counter() - t0, size, path)
+        self.drain_metrics()  # checkpoint barrier = slab drain point
         return path
 
     def read_state(self, col: str, ids=None) -> np.ndarray:
@@ -680,6 +691,38 @@ class MeshSentinel:
             "suspected": sorted(self._monitor.suspected()),
             "failover_stats": [dict(s) for s in self.failover_stats],
         }
+
+    def _sentinel_metrics(self) -> Dict[str, Any]:
+        """Numeric view for the MetricsRegistry collector: suspicion count
+        and the max phi across shards (the detector's continuous health
+        signal) on top of the scalar sentinel_stats fields."""
+        st = self.sentinel_stats()
+        st["suspected_count"] = len(st.pop("suspected", ()))
+        st.pop("failover_stats", None)
+        st.pop("halted", None)
+        phi = 0.0
+        for s in range(len(self.devices)):
+            try:
+                phi = max(phi, float(self._monitor.phi(s)))
+            except Exception:  # noqa: BLE001 — phi before first heartbeat
+                break
+        st["phi_max"] = phi
+        return st
+
+    def drain_metrics(self) -> None:
+        """Epoch-gated device-slab drain into the registry (see
+        BatchedRuntimeHandle.drain_metrics)."""
+        reg = self.metrics_registry
+        if reg is None or not self.metrics_enabled:
+            return
+        with self._step_lock:
+            drained = self.system.drain_metrics()
+            host_step = self.system._host_step
+        if drained is not None:
+            step, lanes = drained
+            reg.ingest_device_slab(lanes, step)
+        else:
+            reg.set_step(host_step)
 
     def shutdown(self) -> None:
         with self._step_lock:

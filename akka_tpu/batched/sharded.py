@@ -49,6 +49,8 @@ except ImportError:  # jax < 0.5: experimental module, check_vma spelt check_rep
 from ..ops.segment import exchange_uses_ranked, stable_ranks
 from ..parallel.mesh import make_mesh
 from .behavior import BatchedBehavior
+from .metrics_slab import (ASK_ARM_COL, ASK_ARM_SPEC, N_BUCKETS, N_HIST,
+                           accumulate_step, slab_dict)
 from .step import StepCore
 from .supervision import (ATT_WORDS, N_COUNTERS, SUP_COLUMNS, counts_dict,
                           decode_attention, reserved_fill)
@@ -65,7 +67,8 @@ class ShardedBatchedSystem:
                  spill_capacity: Optional[int] = None,
                  delivery: str = "auto",
                  delivery_backend: Optional[str] = None,
-                 attention_latch_col: Optional[str] = None):
+                 attention_latch_col: Optional[str] = None,
+                 metrics_enabled: bool = False):
         self.mesh = mesh if mesh is not None else make_mesh(n_devices, axis_name)
         self.axis = axis_name
         self.n_shards = self.mesh.shape[axis_name]
@@ -137,6 +140,12 @@ class ShardedBatchedSystem:
                 self.state_spec.setdefault(col, spec)
         elif any(getattr(b, "nonfinite_guard", False) for b in behaviors):
             self.state_spec.setdefault("_failed", SUP_COLUMNS["_failed"])
+        # telemetry plane (metrics_slab.py): per-shard histogram slab rides
+        # the carry like sup_counts; ask-latency needs the arm-stamp column
+        # sharded with the state so a rebalanced promise row keeps its clock
+        self.metrics_on = bool(metrics_enabled)
+        if self.metrics_on and attention_latch_col is not None:
+            self.state_spec.setdefault(ASK_ARM_COL, ASK_ARM_SPEC)
 
         shard = NamedSharding(self.mesh, P(axis_name))
         n = self.capacity
@@ -165,6 +174,12 @@ class ShardedBatchedSystem:
         self.inbox_payload = jax.device_put(
             jnp.zeros((m_global, payload_width), payload_dtype), shard)
         self.inbox_valid = jax.device_put(jnp.zeros((m_global,), jnp.bool_), shard)
+        # enqueue-step stamps for the sojourn lane (metrics_slab.py): one
+        # int32 per inbox row when metrics are on, a zero-size placeholder
+        # otherwise so the carry structure is static either way
+        self.inbox_enq = jax.device_put(
+            jnp.zeros((m_global,) if self.metrics_on else (0,), jnp.int32),
+            shard)
         self.dropped = jax.device_put(jnp.zeros((self.n_shards,), jnp.int32), shard)
         self.mail_dropped = jax.device_put(
             jnp.zeros((self.n_shards,), jnp.int32), shard)
@@ -172,6 +187,17 @@ class ShardedBatchedSystem:
         # COUNTER_NAMES order) — summed over shards on host read
         self.sup_counts = jax.device_put(
             jnp.zeros((self.n_shards, N_COUNTERS), jnp.int32), shard)
+        # per-shard metric slab ([n_shards, N_HIST, N_BUCKETS]) — summed
+        # over shards on host drain, exactly like sup_counts. Allocated
+        # even when off: static carry structure, trace-time gating.
+        self.metrics = jax.device_put(
+            jnp.zeros((self.n_shards, N_HIST, N_BUCKETS), jnp.int32), shard)
+        # epoch word (slab running sum): a non-donated replicated output of
+        # every run(), read with one scalar fetch to decide if a full slab
+        # drain is worth the bytes (drain_metrics)
+        self.metrics_epoch = jax.device_put(
+            jnp.asarray(0, jnp.int32), NamedSharding(self.mesh, P()))
+        self._metrics_seen_epoch = 0
         # host-attention words (supervision.pack_attention): one
         # [ATT_WORDS] row PER SHARD, sharded with everything else, each
         # recomputed from the final carry of every run(). The pipelined
@@ -225,14 +251,15 @@ class ShardedBatchedSystem:
         ranked_exchange = exchange_uses_ranked(platform, self.delivery_backend)
 
         def local_step(state, behavior_id, alive, inbox_dst, inbox_type,
-                       inbox_payload, inbox_valid, dropped, mail_dropped,
-                       sup_counts, step_count, tables):
+                       inbox_payload, inbox_valid, inbox_enq, dropped,
+                       mail_dropped, sup_counts, metrics, step_count, tables):
             # shapes here are per-shard blocks
             shard_idx = jax.lax.axis_index(axis)
             base = shard_idx * n_local
+            old_state, old_alive = state, alive
 
             (new_state, behavior_id, alive, emits, mdrop, spill,
-             sup_delta) = core.run_local(
+             sup_delta, dcount) = core.run_local(
                 state, behavior_id, alive, inbox_dst, inbox_type,
                 inbox_payload, inbox_valid, step_count,
                 dst_offset=base, id_base=base, tables=tables)
@@ -354,18 +381,47 @@ class ShardedBatchedSystem:
             new_mail_dropped = mail_dropped + mdrop
             new_sup_counts = sup_counts + sup_delta[None, :]
 
+            if self.metrics_on:
+                # histograms read THIS step's inputs (old state, the inbox
+                # we just delivered from, its enqueue stamps); the per-shard
+                # slab block is [1, N_HIST, N_BUCKETS], same row trick as
+                # sup_counts
+                new_metrics = accumulate_step(
+                    metrics[0], old_state, new_state, old_alive, dcount,
+                    inbox_valid, inbox_enq, step_count,
+                    latch_col=core.attention_latch_col)[None]
+                # received rows are RE-stamped with the local clock instead
+                # of exchanging the writer's stamp (no extra collective; a
+                # stray forward resets the age clock — docs/OBSERVABILITY.md)
+                stamp = jnp.broadcast_to(
+                    jnp.asarray(step_count, jnp.int32), (r,))
+                new_inbox_enq = upd(inbox_enq, stamp,
+                                    (sc,)).at[sc + r:].set(0)
+                if spill is not None:
+                    # spill rows are a compacted permutation of the old
+                    # inbox, so stamps can't be copied positionally: re-arm
+                    # at injection (age counts steps since last (re)stamp,
+                    # same rule as the single-device runtime)
+                    new_inbox_enq = new_inbox_enq.at[:sc].set(
+                        jnp.asarray(step_count, jnp.int32))
+            else:
+                new_metrics = metrics
+                new_inbox_enq = inbox_enq
+
             return (new_state, behavior_id, alive, new_inbox_dst,
                     new_inbox_type, new_inbox_payload, new_inbox_valid,
-                    new_dropped, new_mail_dropped, new_sup_counts,
-                    step_count + 1)
+                    new_inbox_enq, new_dropped, new_mail_dropped,
+                    new_sup_counts, new_metrics, step_count + 1)
 
         mesh = self.mesh
         state_specs = {k: P(axis) for k in self.state_spec}
         table_specs = {k: P() for k in self.tables}  # replicated, tiny
         in_specs = (state_specs, P(axis), P(axis), P(axis), P(axis), P(axis),
-                    P(axis), P(axis), P(axis), P(axis), P(), table_specs)
+                    P(axis), P(axis), P(axis), P(axis), P(axis), P(axis),
+                    P(), table_specs)
         out_specs = (state_specs, P(axis), P(axis), P(axis), P(axis), P(axis),
-                     P(axis), P(axis), P(axis), P(axis), P())
+                     P(axis), P(axis), P(axis), P(axis), P(axis), P(axis),
+                     P())
 
         sharded = shard_map(local_step, mesh=mesh, in_specs=in_specs,
                             out_specs=out_specs, check_vma=False)
@@ -383,21 +439,25 @@ class ShardedBatchedSystem:
             out_specs=P(axis), check_vma=False)
 
         def multi_step(state, behavior_id, alive, inbox_dst, inbox_type,
-                       inbox_payload, inbox_valid, dropped, mail_dropped,
-                       sup_counts, step_count, tables, n_steps: int):
+                       inbox_payload, inbox_valid, inbox_enq, dropped,
+                       mail_dropped, sup_counts, metrics, step_count, tables,
+                       n_steps: int):
             def body(carry, _):
                 return sharded(*carry, tables), None
             carry = (state, behavior_id, alive, inbox_dst, inbox_type,
-                     inbox_payload, inbox_valid, dropped, mail_dropped,
-                     sup_counts, step_count)
+                     inbox_payload, inbox_valid, inbox_enq, dropped,
+                     mail_dropped, sup_counts, metrics, step_count)
             carry, _ = jax.lax.scan(body, carry, None, length=n_steps)
             # host-attention words from the final carry: every field is
             # carry-derived (flags = current state, counters cumulative),
             # so one per-shard reduction per run() covers the window —
             # nothing rides the scan. Appended OUTSIDE the donation set.
-            attention = att_map(carry[0], carry[7], carry[8], carry[9],
-                                carry[10])
-            return carry + (attention,)
+            attention = att_map(carry[0], carry[8], carry[9], carry[10],
+                                carry[12])
+            # metrics epoch: the slab's running sum, same non-donated trick
+            epoch = (jnp.sum(carry[11]).astype(jnp.int32)
+                     if self.metrics_on else jnp.asarray(0, jnp.int32))
+            return carry + (attention, epoch)
 
         # pin output shardings to the INPUT shardings: without this, GSPMD
         # may normalize an output (observed: inbox_payload -> replicated on
@@ -407,9 +467,10 @@ class ShardedBatchedSystem:
         repl_s = NamedSharding(mesh, P())
         out_shardings = ({k: shard_s for k in self.state_spec},
                          shard_s, shard_s, shard_s, shard_s, shard_s,
-                         shard_s, shard_s, shard_s, shard_s, repl_s, shard_s)
-        return jax.jit(multi_step, static_argnums=(12,),
-                       donate_argnums=tuple(range(10)),
+                         shard_s, shard_s, shard_s, shard_s, shard_s,
+                         shard_s, repl_s, shard_s, repl_s)
+        return jax.jit(multi_step, static_argnums=(14,),
+                       donate_argnums=tuple(range(12)),
                        out_shardings=out_shardings)
 
     # ------------------------------------------------------------- lifecycle
@@ -470,6 +531,12 @@ class ShardedBatchedSystem:
         self.inbox_payload = self.inbox_payload.at[idx].set(
             jnp.asarray(np.stack(pls), self.payload_dtype))
         self.inbox_valid = self.inbox_valid.at[idx].set(True)
+        if self.metrics_on:
+            # host flush stamps with the dispatched-step mirror: the rows
+            # are delivered by the next dispatched step, so a drained
+            # pipeline reads sojourn age 0 for host mail (fused-flush
+            # convention, BatchedSystem._flush_impl)
+            self.inbox_enq = self.inbox_enq.at[idx].set(self._host_step)
 
     def set_tables(self, tables: Dict[str, Any]) -> None:
         """Install/replace the replicated lookup tables behaviors see via
@@ -520,6 +587,8 @@ class ShardedBatchedSystem:
         self.inbox_type = regrid(self.inbox_type, 0)
         self.inbox_payload = regrid(self.inbox_payload, 0)
         self.inbox_valid = regrid(self.inbox_valid, False)
+        if self.metrics_on:  # (0,) placeholder when off — nothing to regrid
+            self.inbox_enq = regrid(self.inbox_enq, 0)
         self.pair_cap = new_pair_cap
         self.m_local = new_ml
 
@@ -575,14 +644,15 @@ class ShardedBatchedSystem:
                 self._build_step(self.stray_mode)
         self._flush_staged()
         (self.state, self.behavior_id, self.alive, self.inbox_dst,
-         self.inbox_type, self.inbox_payload, self.inbox_valid, self.dropped,
-         self.mail_dropped, self.sup_counts, self.step_count,
-         self.attention) = \
+         self.inbox_type, self.inbox_payload, self.inbox_valid,
+         self.inbox_enq, self.dropped, self.mail_dropped, self.sup_counts,
+         self.metrics, self.step_count, self.attention,
+         self.metrics_epoch) = \
             self._step_fn(self.state, self.behavior_id, self.alive,
                           self.inbox_dst, self.inbox_type, self.inbox_payload,
-                          self.inbox_valid, self.dropped, self.mail_dropped,
-                          self.sup_counts, self.step_count, self.tables,
-                          n_steps)
+                          self.inbox_valid, self.inbox_enq, self.dropped,
+                          self.mail_dropped, self.sup_counts, self.metrics,
+                          self.step_count, self.tables, n_steps)
         self._host_step += int(n_steps)
 
     step = run
@@ -713,10 +783,36 @@ class ShardedBatchedSystem:
         # sync via host read of a non-donated output (see core.py note)
         np.asarray(jax.device_get(self.step_count))
 
+    # ------------------------------------------------------- telemetry plane
+    def metrics_epoch_value(self) -> int:
+        """ONE scalar device_get of the metrics-epoch word (the slab's
+        running sum, recomputed outside the donated carry each run). Also
+        syncs the newest dispatched run, like read_attention."""
+        return int(jax.device_get(self.metrics_epoch))
+
+    def read_metrics(self) -> Dict[str, np.ndarray]:
+        """Host copy of the metric slab as named lanes (shards summed) —
+        see metrics_slab.slab_dict. Drains the pipeline first."""
+        self.block_until_ready()
+        return slab_dict(self.metrics)
+
+    def drain_metrics(self):
+        """Cheap conditional drain for the bridge pump's busy→idle edge:
+        returns (step, lanes) when the slab changed since the last drain,
+        None otherwise — the quiet path costs one scalar fetch."""
+        if not self.metrics_on:
+            return None
+        epoch = self.metrics_epoch_value()
+        if epoch == self._metrics_seen_epoch:
+            return None
+        self._metrics_seen_epoch = epoch
+        step = int(np.asarray(jax.device_get(self.step_count)))
+        return step, slab_dict(self.metrics)
+
     # ------------------------------------------------- checkpoint / recovery
     def checkpoint(self, directory: str, keep: Optional[int] = None) -> str:
         """Checkpoint barrier (see BatchedSystem.checkpoint): quiesce on
-        the non-donated step_count, snapshot the schema-v2 slab pytree
+        the non-donated step_count, snapshot the schema-v3 slab pytree
         (slab_snapshot host-gathers the mesh-sharded slabs), compact the
         attached tell journal, GC retained snapshots."""
         from ..persistence.slab_snapshot import gc_slabs, save_slabs
@@ -749,9 +845,16 @@ class ShardedBatchedSystem:
         if tuple(np.asarray(tree["inbox_dst"]).shape) == \
                 tuple(self.inbox_dst.shape):
             restore_slab_pytree(self, tree)
+            # re-arm the drain gate against the restored slab (the
+            # resharded path recomputes the epoch itself)
+            self.metrics_epoch = jax.device_put(
+                jnp.asarray(int(np.asarray(
+                    jax.device_get(self.metrics)).sum()), jnp.int32),
+                NamedSharding(self.mesh, P()))
         else:
             self._restore_resharded(tree)
         self._host_step = int(np.asarray(jax.device_get(self.step_count)))
+        self._metrics_seen_epoch = 0  # next drain re-ingests the slab
         with self._lock:
             self._host_staged = []
         if journal is not None:
@@ -826,6 +929,16 @@ class ShardedBatchedSystem:
             sc[0] = np.asarray(tree["sup_counts"]).reshape(
                 -1, N_COUNTERS).sum(axis=0)
         self.sup_counts = jax.device_put(jnp.asarray(sc), shard)
+        # metric slab: conserve histogram counts into row 0, like the
+        # other per-shard aggregates (only totals are ever read)
+        mt = np.zeros((ns, N_HIST, N_BUCKETS), np.int32)
+        if "metrics" in tree:
+            mt[0] = np.asarray(tree["metrics"]).reshape(
+                -1, N_HIST, N_BUCKETS).sum(axis=0)
+        self.metrics = jax.device_put(jnp.asarray(mt), shard)
+        self.metrics_epoch = jax.device_put(
+            jnp.asarray(int(mt.sum()), jnp.int32), repl)
+        self._metrics_seen_epoch = 0
         # in-flight mail: gather valid rows, re-place by destination shard
         dst = np.asarray(tree["inbox_dst"])
         typ = np.asarray(tree["inbox_type"])
@@ -861,3 +974,10 @@ class ShardedBatchedSystem:
         self.inbox_payload = jax.device_put(
             jnp.asarray(new_pl, self.payload_dtype), shard)
         self.inbox_valid = jax.device_put(jnp.asarray(new_val), shard)
+        if self.metrics_on:
+            # enqueue stamps don't survive a re-shard positionally: re-arm
+            # every re-placed row at the restored step (age restarts, same
+            # rule as the exchange re-stamp)
+            restored = int(np.asarray(tree["step_count"]).max())
+            enq = np.where(new_val, restored, 0).astype(np.int32)
+            self.inbox_enq = jax.device_put(jnp.asarray(enq), shard)

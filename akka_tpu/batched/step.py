@@ -294,13 +294,17 @@ class StepCore:
                   inbox_payload, inbox_valid, step_count, topo_arrays=(),
                   dst_offset=None, id_base=0, tables=()):
         """deliver + update in one call. Returns (new_state, new_behavior_id,
-        new_alive, emits, dropped, spill, sup_delta) where dropped is this
-        step's REAL message-loss count (0 in reduce mode — reductions never
-        overflow; spill-region overflow in slots mode), spill is a
-        (dst, type, payload, valid) tuple of retained mail to re-inject at
-        the FRONT of the next inbox (spill dst is GLOBAL — dst_offset
-        re-applied), or None when spill_cap == 0, and sup_delta is the
-        [N_COUNTERS] supervision counter increment."""
+        new_alive, emits, dropped, spill, sup_delta, delivered_count) where
+        dropped is this step's REAL message-loss count (0 in reduce mode —
+        reductions never overflow; spill-region overflow in slots mode),
+        spill is a (dst, type, payload, valid) tuple of retained mail to
+        re-inject at the FRONT of the next inbox (spill dst is GLOBAL —
+        dst_offset re-applied), or None when spill_cap == 0, sup_delta is
+        the [N_COUNTERS] supervision counter increment, and delivered_count
+        is the [n_local] int32 per-lane delivery count of this step — the
+        mailbox-occupancy sample the metric slab histograms
+        (batched/metrics_slab.py; free either way, the delivery kernel
+        already computes it)."""
         slots_kind_row = suspended = None
         if self.slots > 0 and self.spill_cap > 0:
             slots_kind_row = self._slots_kind[behavior_id]
@@ -333,7 +337,7 @@ class StepCore:
         else:
             dropped = jnp.asarray(0, jnp.int32)
         return (new_state, new_behavior_id, alive, emits, dropped, spill,
-                sup_delta)
+                sup_delta, d.count)
 
 
 # -------------------------------------------------- shared fault handling

@@ -195,6 +195,10 @@ def reference_config() -> Config:
                     "sentinel-acceptable-pause": "3s",
                     "sentinel-max-failovers": 3,
                     "mesh-axes": {},
+                    # per-dispatcher override of akka.metrics.enabled:
+                    # compiles the device metric slab into this
+                    # dispatcher's step even without the system-wide plane
+                    "metrics-enabled": False,
                 },
                 "default-mailbox": {
                     "mailbox-type": "unbounded",
@@ -233,6 +237,19 @@ def reference_config() -> Config:
             "serialization": {
                 "serializers": {},         # name -> FQCN
                 "serialization-bindings": {},  # FQCN of message class -> serializer name
+            },
+            # unified telemetry plane (event/metrics.py + the device metric
+            # slab, batched/metrics_slab.py): off by default — enabling it
+            # compiles the slab into tpu-batched steps and builds the
+            # system-owned MetricsRegistry. http-port > 0 serves
+            # Prometheus exposition on 127.0.0.1; jsonl-path arms the
+            # periodic emitter (flight-recorder file conventions).
+            "metrics": {
+                "enabled": False,
+                "namespace": "akka",
+                "http-port": 0,
+                "jsonl-path": "",
+                "jsonl-interval": "1s",
             },
             "remote": {
                 "canonical": {"hostname": "127.0.0.1", "port": 0},

@@ -78,6 +78,18 @@ class TpuBatchedDispatcher(Dispatcher):
                     sentinel_max_failovers=overrides.get(
                         "sentinel_max_failovers",
                         c.get_int("sentinel-max-failovers", 3)),
+                    # telemetry plane: the system-level akka.metrics.enabled
+                    # switch (or an explicit override) compiles the device
+                    # metric slab in; the system-owned registry is shared
+                    # so every dispatcher's collectors land in one plane
+                    metrics_enabled=overrides.get(
+                        "metrics_enabled",
+                        c.get_bool("metrics-enabled", False) or
+                        getattr(system, "metrics_registry", None)
+                        is not None),
+                    metrics_registry=overrides.get(
+                        "metrics_registry",
+                        getattr(system, "metrics_registry", None)),
                 )
             return self._handle
 

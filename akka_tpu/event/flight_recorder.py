@@ -20,12 +20,13 @@ bootstrap; `noop` costs one no-inlined method call per hook, nothing else.
 
 from __future__ import annotations
 
+import inspect
 import json
 import os
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 
 class FlightRecorder:
@@ -128,39 +129,29 @@ def _structured(method_name):
     return hook
 
 
+# Recorder plumbing on the SPI that is NOT a structured hook: the **fields
+# escape hatch and the buffer/lifecycle accessors.
+_NON_HOOKS = frozenset({"event", "events", "close"})
+
+
+def spi_hook_fields() -> Dict[str, Tuple[str, ...]]:
+    """hook name -> positional field names, derived from the FlightRecorder
+    SPI signatures themselves. Adding a hook to the SPI (or a field to an
+    existing hook) updates every structured recorder automatically — the
+    hand-maintained copy of this table used to drift one hook behind."""
+    fields: Dict[str, Tuple[str, ...]] = {}
+    for name, fn in vars(FlightRecorder).items():
+        if name.startswith("_") or name in _NON_HOOKS or not callable(fn):
+            continue
+        params = tuple(inspect.signature(fn).parameters)
+        fields[name] = params[1:]  # drop self
+    return fields
+
+
 class InMemoryFlightRecorder(FlightRecorder):
     """Bounded ring of structured events; the testkit/debug recorder."""
 
-    _FIELDS = {
-        "actor_spawned": ("path",),
-        "actor_stopped": ("path",),
-        "actor_failed": ("path", "cause"),
-        "actor_restarted": ("path", "cause"),
-        "transport_started": ("address",),
-        "association_opened": ("peer",),
-        "association_quarantined": ("peer", "reason"),
-        "remote_message_sent": ("peer", "size"),
-        "remote_message_received": ("peer", "size"),
-        "device_step": ("system", "n_steps", "elapsed_s"),
-        "device_flush": ("system", "staged"),
-        "device_compile": ("system", "elapsed_s"),
-        "dropped": ("system", "count"),
-        "device_supervision": ("system", "steps", "failed", "resumed",
-                               "restarted", "stopped", "escalated",
-                               "dead_letters"),
-        "device_pipeline": ("system", "depth", "steps", "drains",
-                            "wide_resolves", "host_checks"),
-        "device_checkpoint": ("system", "step", "elapsed_s", "size_bytes",
-                              "path"),
-        "checkpoint_failed": ("system", "error", "consecutive"),
-        "journal_truncated": ("path", "dropped_bytes"),
-        "device_suspected": ("system", "shard", "phi", "detector"),
-        "device_evicted": ("system", "shard", "step"),
-        "failover_completed": ("system", "lost_shards", "survivors", "step",
-                               "mttr_s"),
-        "failover_halted": ("system", "failovers", "reason"),
-        "shard_overflow": ("system", "shard", "mailbox_overflow", "dropped"),
-    }
+    _FIELDS = spi_hook_fields()
 
     def __init__(self, capacity: int = 4096):
         self._buf: deque = deque(maxlen=capacity)

@@ -7,15 +7,19 @@ akka_tpu.batched.BatchedSystem: every device-resident slab (per-column actor
 state, behavior ids, alive mask, inbox tensors, step counter, supervision
 counters, attention word) is serialized as one pytree.
 
-Schema v2 (docs/CHECKPOINT_RECOVERY.md has the full layout): v1 carried only
+Schema v3 (docs/CHECKPOINT_RECOVERY.md has the full layout): v1 carried only
 the seven core slabs and silently dropped the supervision aggregates added
 since — a restore of a v1 snapshot into a supervised system would resume
 with whatever stale counters the target happened to hold. v2 adds
 `mail_dropped`, `sup_counts`, `attention` and the sharded `dropped` block
-plus an explicit `schema_version` field; the loader still accepts v1
-snapshots and ZERO-FILLS (with `reserved_fill`) every live slab the snapshot
-does not carry, so the restored state is a pure function of the snapshot
-file, never of the pre-restore target.
+plus an explicit `schema_version` field. v3 adds the telemetry plane:
+the `metrics` histogram slab and the `inbox_enq` enqueue-step column
+(docs/OBSERVABILITY.md) — both are derived telemetry whose shapes depend
+on whether metrics are compiled in, so on shape mismatch they zero-fill
+instead of failing the restore (like `attention`). The loader still
+accepts v1/v2 snapshots and ZERO-FILLS (with `reserved_fill`) every live
+slab the snapshot does not carry, so the restored state is a pure function
+of the snapshot file, never of the pre-restore target.
 
 Uses orbax-checkpoint when importable (async-friendly, TPU-native sharding
 aware) and falls back to a .npz file — the pytree layout is identical, so
@@ -41,7 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 # v1 slabs: core actor/inbox tensors (pre-supervision snapshots carry only
 # these).
@@ -50,7 +54,15 @@ _SLAB_KEYS_V1 = ("behavior_id", "alive", "step_count", "inbox_dst",
 # v2 additions: supervision aggregates + the attention word. `dropped`
 # exists only on ShardedBatchedSystem; getattr-None skips it elsewhere.
 _SLAB_KEYS_V2 = ("mail_dropped", "sup_counts", "attention", "dropped")
-_SLAB_KEYS = _SLAB_KEYS_V1 + _SLAB_KEYS_V2
+# v3 additions: the telemetry plane — the device metric slab and the
+# per-row enqueue-step column feeding the sojourn histogram. Shapes vary
+# with metrics_on / shard count, so mismatches zero-fill (see below).
+_SLAB_KEYS_V3 = ("metrics", "inbox_enq")
+_SLAB_KEYS = _SLAB_KEYS_V1 + _SLAB_KEYS_V2 + _SLAB_KEYS_V3
+
+# Derived telemetry, not source state: a layout change across runtimes
+# zero-fills instead of raising, and the next step/drain repopulates it.
+_ZERO_FILL_ON_MISMATCH = ("attention", "metrics", "inbox_enq")
 
 
 def _reserved_fill(col: str) -> int:
@@ -71,7 +83,10 @@ def slab_pytree(system) -> Dict[str, Any]:
                   for k, v in system.state.items()}}
     for k in _SLAB_KEYS:
         v = getattr(system, k, None)
-        if v is not None:
+        # zero-size slabs (inbox_enq with metrics compiled out) are
+        # omitted: tensorstore refuses empty params, and the restore path
+        # zero-fills absent v3 keys anyway
+        if v is not None and getattr(v, "size", 1) != 0:
             tree[k] = np.asarray(jax.device_get(v))
     return tree
 
@@ -128,11 +143,11 @@ def restore_slab_pytree(system, tree: Dict[str, Any]) -> None:
             arr = tree[k]
             if hasattr(cur, "shape") and tuple(cur.shape) != tuple(
                     np.asarray(arr).shape):
-                if k == "attention":
-                    # derived per-step summary, not source state: a layout
-                    # change (e.g. the 4-word pre-progress-lane format, or
-                    # per-shard rows from another mesh) zero-fills and the
-                    # first restored step repacks it
+                if k in _ZERO_FILL_ON_MISMATCH:
+                    # derived telemetry, not source state: a layout change
+                    # (the 4-word pre-progress-lane attention format,
+                    # per-shard rows from another mesh, or a metrics-on/off
+                    # flip) zero-fills and the first restored step repacks
                     setattr(system, k, _put_like(
                         system, jnp.zeros(cur.shape, cur.dtype), cur))
                     continue
@@ -140,8 +155,8 @@ def restore_slab_pytree(system, tree: Dict[str, Any]) -> None:
                     f"slab shape mismatch for {k}: "
                     f"{np.asarray(arr).shape} vs {tuple(cur.shape)}")
             setattr(system, k, _put_like(system, arr, cur))
-        elif k in _SLAB_KEYS_V2:
-            # v1 snapshot: the aggregate never existed — zero it
+        elif k in _SLAB_KEYS_V2 or k in _SLAB_KEYS_V3:
+            # older snapshot: the aggregate never existed — zero it
             fill = jnp.zeros(cur.shape, cur.dtype)
             setattr(system, k, _put_like(system, fill, cur))
 

@@ -623,6 +623,71 @@ def bench_supervision(n, steps):
     }
 
 
+def bench_metrics_overhead(n, steps):
+    """Telemetry-plane A/B row (docs/OBSERVABILITY.md): the SAME dynamic
+    ring stepped with the metric slab compiled out vs in, twice — once
+    UNSEEDED (no token, every step quiet: prices the busy-predicate gate,
+    the <=1% contract of ISSUE 7) and once seeded (a message every step:
+    prices the four histogram scatters on the active path, informative
+    only). All four variants are built first and timed in interleaved
+    best-of windows (the bench_supervision drift discipline), and every
+    A/B row carries a host load stamp taken AT ITS OWN measurement — the
+    artifact shows not just the delta but the load both sides saw."""
+    from akka_tpu.batched import BatchedSystem
+    from akka_tpu.models.baseline_benches import (PAYLOAD_W, ring_behavior,
+                                                  seed_ring_full)
+
+    def build(metrics, seeded):
+        s = BatchedSystem(capacity=n, behaviors=[ring_behavior],
+                          payload_width=PAYLOAD_W, host_inbox=8,
+                          metrics_enabled=metrics)
+        s.spawn_block(ring_behavior, n)
+        if seeded:
+            seed_ring_full(s)
+        s.run(steps)
+        s.block_until_ready()  # compile + warm the exact run(steps) program
+        return s
+
+    def host_stamp():
+        l1, l5, _ = os.getloadavg()
+        return {"loadavg": [round(l1, 2), round(l5, 2)],
+                "ts": round(time.time(), 1)}
+
+    variants = (("quiet-off", False, False), ("quiet-on", True, False),
+                ("active-off", False, True), ("active-on", True, True))
+    systems = [build(m, s) for _, m, s in variants]
+    best = [None] * 4
+    stamps = [None] * 4
+    for _ in range(5):
+        for i, s in enumerate(systems):
+            t0 = time.perf_counter()
+            s.run(steps)
+            s.block_until_ready()
+            dt = time.perf_counter() - t0
+            if best[i] is None or dt < best[i]:
+                best[i], stamps[i] = dt, host_stamp()
+    rows = [{"variant": name, "metrics": m, "seeded": sd,
+             "ms_per_step": round(best[i] * 1e3 / steps, 4),
+             "host": stamps[i]}
+            for i, (name, m, sd) in enumerate(variants)]
+    q_off, q_on, a_off, a_on = best
+    # quiet contract: the gated pass must leave the slab EMPTY (epoch 0 —
+    # no idle-step bucket-0 spam) as well as cheap
+    quiet_epoch = systems[1].metrics_epoch_value()
+    drained = systems[3].drain_metrics()
+    lanes = {k: int(v.sum()) for k, v in drained[1].items()} \
+        if drained else {}
+    return {
+        "rows": rows,
+        "quiet_overhead_pct": round((q_on - q_off) / q_off * 100.0, 2),
+        "quiet_ok": quiet_epoch == 0,
+        "active_overhead_pct": round((a_on - a_off) / a_off * 100.0, 2),
+        "lanes_sampled": lanes,
+        "active_ok": bool(lanes) and lanes.get("mailbox_occupancy", 0) > 0
+        and lanes.get("sojourn_steps", 0) > 0,
+    }
+
+
 def bench_checkpoint(n, interval=256, windows=3, directory=None):
     """Checkpoint-overhead row (docs/CHECKPOINT_RECOVERY.md): the SAME
     dynamic ring driven as per-dispatch steps, bare vs with a barrier
@@ -799,6 +864,7 @@ def main() -> None:
                                          "shard-api", "latency",
                                          "bridge-latency", "modes",
                                          "supervision", "checkpoint-overhead",
+                                         "metrics-overhead",
                                          "failover-mttr", "spawn", "stream"],
                     help="run a single config (spawn/stream are extra "
                          "JMH-analogue microbenches outside the default "
@@ -1008,6 +1074,21 @@ def main() -> None:
                     "value": out["overhead_pct"], "unit": "pct",
                     "vs_baseline": 1.0,
                     "extra": {"checkpoint": out, **extra}}))
+            elif args.config == "metrics-overhead":
+                mo_n = min(n, 1 << 16)  # the <=1% contract scale (64k lanes)
+                out = bench_metrics_overhead(mo_n, mode_steps)
+                print(f"[bench] metrics: quiet="
+                      f"{out['quiet_overhead_pct']}% "
+                      f"({'OK' if out['quiet_ok'] else 'FAIL'}) "
+                      f"active={out['active_overhead_pct']}% "
+                      f"lanes={out['lanes_sampled']}", file=sys.stderr)
+                print(json.dumps({
+                    "metric": "telemetry-plane overhead, dynamic ring "
+                              "(metric slab compiled in, quiet path)"
+                              + scale_tag,
+                    "value": out["quiet_overhead_pct"], "unit": "pct",
+                    "vs_baseline": 1.0,
+                    "extra": {"metrics": out, **extra}}))
             elif args.config == "failover-mttr":
                 fo_n = min(n, 1 << 12) if on_cpu else n
                 out = bench_failover(fo_n, steps=48)

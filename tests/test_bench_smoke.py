@@ -71,6 +71,27 @@ def test_supervision_overhead_budget():
         f"(contract: <=5% at bench scale): {out}")
 
 
+def test_metrics_overhead_budget():
+    """ISSUE 7 satellite: the in-graph metric slab with NO traffic must
+    cost <= 1% of step time at bench scale (64k lanes). Every histogram
+    update is behind one busy predicate (any inbox row valid, any retry
+    counter grew, any ask latch newly latched), so a quiet step pays only
+    that predicate and a cond skip — and the slab must stay EMPTY (epoch
+    0), not merely cheap: idle-step bucket-0 samples would both skew the
+    occupancy histogram and defeat the gate. bench_metrics_overhead
+    builds all four variants first and interleaves best-of windows
+    (the bench_supervision drift discipline); the smoke budget keeps
+    headroom over the 1% contract for CI-box noise and the suite's
+    8-virtual-device conftest split — an ungated slab samples 4 lanes x
+    16 buckets every step and lands at 30%+ regardless of the constant."""
+    out = bench.bench_metrics_overhead(n=8192, steps=6)
+    assert out["quiet_ok"], out   # quiet run left the slab empty
+    assert out["active_ok"], out  # seeded run sampled the traffic lanes
+    assert out["quiet_overhead_pct"] <= 15.0, (
+        f"metric-slab quiet overhead {out['quiet_overhead_pct']}% at smoke "
+        f"scale (contract: <=1% at 64k-lane bench scale): {out}")
+
+
 def test_checkpoint_overhead_budget():
     """ISSUE 4 satellite: the auto-checkpoint cadence at interval 256 must
     cost <= 5% of quiet-path step time at bench scale. bench_checkpoint

@@ -45,9 +45,38 @@ def test_probe_strips_jax_platforms(watchdog, monkeypatch):
         return R()
 
     monkeypatch.setattr(wd.subprocess, "run", fake_run)
-    ok, detail = wd.probe(5.0)
+    ok, detail, expose = wd.probe(5.0)
     assert ok and detail == "tpu v5e 1"
+    assert expose is None  # no exposition block in the stdout
     assert "JAX_PLATFORMS" not in seen["env"]
+
+
+def test_probe_splits_metrics_exposition(watchdog, monkeypatch):
+    """Every probe row carries the telemetry sample's registry.expose()
+    dump (ISSUE 7): the sentinel-delimited block is split out of the
+    probe stdout, and the device line alone decides ok/detail."""
+    wd, _ = watchdog
+
+    def fake_run(cmd, **kw):
+        class R:
+            returncode = 0
+            stdout = ("tpu v5e 4\n---EXPOSE---\n"
+                      "# TYPE akka_device_mailbox_occupancy histogram\n"
+                      'akka_device_mailbox_occupancy_bucket{le="0"} 3\n'
+                      "---END-EXPOSE---\n")
+            stderr = ""
+        return R()
+
+    monkeypatch.setattr(wd.subprocess, "run", fake_run)
+    ok, detail, expose = wd.probe(5.0)
+    assert ok and detail == "tpu v5e 4"
+    assert 'mailbox_occupancy_bucket{le="0"} 3' in expose
+
+    # a failed sample keeps its error marker in the detail, expose None
+    detail, expose = wd._split_expose(
+        "cpu cpu 1\n---EXPOSE-ERROR--- ImportError('x')\n")
+    assert expose is None
+    assert "EXPOSE-ERROR" in detail
 
 
 def test_capture_runs_strip_jax_platforms_too(watchdog, monkeypatch):

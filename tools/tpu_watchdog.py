@@ -34,11 +34,42 @@ LOG = os.path.join(REPO, "TPU_PROBELOG.jsonl")
 # faulthandler is armed to fire a few seconds BEFORE the parent's kill, so
 # a hung probe's stderr carries the stack it was wedged on (which C call in
 # the tunnel) instead of dying silently (VERDICT weak #1).
-PROBE_SRC = ("import faulthandler; "
-             "faulthandler.dump_traceback_later({dump_after:.0f}, "
-             "exit=False); "
-             "import jax; d = jax.devices(); "
-             "print(d[0].platform, d[0].device_kind, len(d))")
+#
+# After the device print, the probe runs a tiny metrics-enabled ring on the
+# backend it just found and dumps `registry.expose()` between sentinels
+# (ISSUE 7): every probe row carries full histogram DISTRIBUTIONS, not just
+# totals, so the first real TPU window lands bucket shapes in the committed
+# probelog even if the full bench surface later dies to the budget. The
+# sample is best-effort — an exposition failure never fails the probe.
+PROBE_SRC = """\
+import faulthandler
+faulthandler.dump_traceback_later({dump_after:.0f}, exit=False)
+import jax
+d = jax.devices()
+print(d[0].platform, d[0].device_kind, len(d))
+try:
+    from akka_tpu.batched import BatchedSystem
+    from akka_tpu.event.metrics import MetricsRegistry
+    from akka_tpu.models.baseline_benches import (PAYLOAD_W, ring_behavior,
+                                                  seed_ring_full)
+    s = BatchedSystem(capacity=256, behaviors=[ring_behavior],
+                      payload_width=PAYLOAD_W, host_inbox=8,
+                      metrics_enabled=True)
+    s.spawn_block(ring_behavior, 256)
+    seed_ring_full(s)
+    s.run(8)
+    s.block_until_ready()
+    reg = MetricsRegistry()
+    drained = s.drain_metrics()
+    if drained is not None:
+        step, lanes = drained
+        reg.ingest_device_slab(lanes, step)
+    print("---EXPOSE---")
+    print(reg.expose())
+    print("---END-EXPOSE---")
+except Exception as e:
+    print("---EXPOSE-ERROR---", repr(e))
+"""
 
 
 def _utcnow() -> str:
@@ -46,12 +77,25 @@ def _utcnow() -> str:
         timespec="seconds")
 
 
-def probe(timeout_s: float) -> tuple[bool, str]:
+def _split_expose(stdout: str) -> tuple[str, str | None]:
+    """(device detail line, exposition text or None) from probe stdout."""
+    head, sep, rest = stdout.partition("---EXPOSE---")
+    detail = head.strip().splitlines()
+    if not sep:
+        # expose never started, or the sample itself failed: keep the
+        # error marker line in the detail so the log row explains why
+        return "\n".join(detail).strip()[:500], None
+    return (detail[0] if detail else "",
+            rest.partition("---END-EXPOSE---")[0].strip())
+
+
+def probe(timeout_s: float) -> tuple[bool, str, str | None]:
     """jax.devices() in a throwaway subprocess with a hard timeout.
 
     The wedged axon tunnel HANGS in-process (observed >540s), so the probe
     must be out-of-process and killable. JAX_PLATFORMS is stripped so the
     ambient sitecustomize platform (the tunnel) is what gets probed.
+    Returns (ok, detail, metrics exposition dump or None).
     """
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
     src = PROBE_SRC.format(dump_after=max(timeout_s - 5.0, 1.0))
@@ -69,13 +113,13 @@ def probe(timeout_s: float) -> tuple[bool, str]:
         detail = f"probe timed out after {timeout_s:.0f}s"
         if stack:
             detail += f"; stack tail: {stack}"
-        return False, detail
+        return False, detail, None
     if r.returncode != 0:
         tail = (r.stderr.strip().splitlines() or ["unknown"])[-1][:300]
-        return False, f"rc={r.returncode}: {tail}"
-    detail = r.stdout.strip()
+        return False, f"rc={r.returncode}: {tail}", None
+    detail, expose = _split_expose(r.stdout)
     ok = bool(detail) and not detail.lower().startswith(("cpu", "host"))
-    return ok, detail or "empty probe output"
+    return ok, detail or "empty probe output", expose
 
 
 def append_log(rec: dict) -> None:
@@ -243,6 +287,30 @@ def on_tpu_found(detail: str) -> None:
                         "snapshot_bytes": ck.get("snapshot_bytes"),
                         "interval": ck.get("interval"),
                         "base_ms_per_step": ck.get("base_ms_per_step")})
+    # telemetry plane on-chip: metric-slab quiet/active A/B at 64k lanes
+    # (docs/OBSERVABILITY.md budgets the quiet path at <= 1%) plus the
+    # drained lane totals from the seeded leg
+    run_logged("metrics", [sys.executable, "bench.py", "--config",
+                           "metrics-overhead", "--probe-timeout", "120"],
+               timeout_s=1800)
+    met_out = os.path.join(REPO, "watchdog_metrics.out")
+    if os.path.exists(met_out):
+        mj = None
+        for line in open(met_out):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    mj = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
+        mt = (mj or {}).get("extra", {}).get("metrics", {})
+        if mt:
+            append_log({"ts": _utcnow(), "ok": bool(mt.get("quiet_ok")),
+                        "detail": "telemetry-plane overhead stats",
+                        "quiet_overhead_pct": mt.get("quiet_overhead_pct"),
+                        "active_overhead_pct": mt.get("active_overhead_pct"),
+                        "lanes_sampled": mt.get("lanes_sampled"),
+                        "rows": mt.get("rows")})
     # shard failover on-chip: force-evict one device of the real mesh and
     # record the sentinel's MTTR (suspicion -> first post-failover drain)
     # against a manual restore, plus the device_evicted /
@@ -276,7 +344,7 @@ def on_tpu_found(detail: str) -> None:
     paths = [LOG, "watchdog_bench_full.out", "watchdog_attrib.out",
              "watchdog_trace.out", "watchdog_supervision.out",
              "watchdog_bridge.out", "watchdog_checkpoint.out",
-             "watchdog_failover.out"]
+             "watchdog_metrics.out", "watchdog_failover.out"]
     if last is not None:
         paths.append("BENCH_TPU.json")
     if os.path.isdir(os.path.join(REPO, "traces/tpu_r05")):
@@ -300,11 +368,17 @@ def main() -> None:
         n_probe += 1
         is_long = long_every > 0 and n_probe % long_every == 0
         t0 = time.time()
-        ok, detail = probe(long_timeout if is_long else timeout)
+        ok, detail, expose = probe(long_timeout if is_long else timeout)
         rec = {"ts": _utcnow(), "ok": ok, "detail": detail,
                "probe_s": round(time.time() - t0, 1)}
         if is_long:
             rec["long_timeout_s"] = long_timeout
+        if expose is not None:
+            # the probe's 256-lane telemetry sample: full registry
+            # exposition (histogram buckets + step stamps), committed with
+            # the probelog so distributions survive even a budget-killed
+            # full surface
+            rec["metrics_expose"] = expose
         append_log(rec)
         print(f"[watchdog] probe ok={ok} detail={detail}", flush=True)
         if ok:
