@@ -60,6 +60,15 @@ I32 = jnp.int32
 F32 = jnp.float32
 
 
+class AskPoolExhausted(RuntimeError):
+    """Every promise row is claimed by an in-flight (or quarantined) ask:
+    the ask fails FAST and TYPED instead of queueing or burning its
+    timeout. Admission layers (akka_tpu/gateway/admission.py) catch this
+    to shed load — it is the backpressure signal for the ask pool, the
+    way mailbox_overflow is for tells. Sized by the tpu-batched
+    dispatcher's `promise-rows` config key."""
+
+
 class RecoveredAskLost(Exception):
     """Failed into ask futures that were outstanding when the runtime was
     restored from a checkpoint: promise-row latch state is overwritten by
@@ -167,6 +176,7 @@ class BatchedRuntimeHandle:
                  checkpoint_interval_steps: int = 0,
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_keep: int = 3,
+                 wal_fsync_every_n: int = 1,
                  sentinel_threshold: float = 8.0,
                  sentinel_heartbeat_interval: float = 0.1,
                  sentinel_acceptable_pause: float = 3.0,
@@ -245,6 +255,7 @@ class BatchedRuntimeHandle:
         # hard deadline passes — freeing immediately could hand the slot to
         # a new ask that then completes with the previous question's answer
         self._promise_zombies: Dict[int, float] = {}
+        self._stat_ask_exhausted = 0  # typed fast-fails (AskPoolExhausted)
 
         # pump
         self._pump_thread: Optional[threading.Thread] = None
@@ -287,6 +298,7 @@ class BatchedRuntimeHandle:
         self.checkpoint_interval_steps = max(0, int(checkpoint_interval_steps))
         self.checkpoint_dir = checkpoint_dir or None
         self.checkpoint_keep = max(1, int(checkpoint_keep))
+        self.wal_fsync_every_n = max(1, int(wal_fsync_every_n))
         self._journal = None  # persistence.tell_journal.TellJournal
         self._ckpt_last_step = 0
         self._ckpt_failures = 0        # consecutive failures (backoff rank)
@@ -316,6 +328,7 @@ class BatchedRuntimeHandle:
             reg.register_collector("pipeline", self.pipeline_stats)
             reg.register_collector("checkpoint", self.checkpoint_stats)
             reg.register_collector("sentinel", self._sentinel_metrics)
+            reg.register_collector("ask_pool", self.ask_pool_stats)
 
     # -------------------------------------------------------------- behaviors
     def _behavior_index(self, b: BatchedBehavior) -> int:
@@ -464,7 +477,8 @@ class BatchedRuntimeHandle:
                 from ..persistence.tell_journal import TellJournal
                 self._journal = TellJournal(
                     os.path.join(self.checkpoint_dir, "tells.wal"),
-                    flight_recorder=self.flight_recorder)
+                    flight_recorder=self.flight_recorder,
+                    fsync_every_n=self.wal_fsync_every_n)
             except OSError as e:
                 fr = self.flight_recorder
                 if fr is not None and fr.enabled:
@@ -605,7 +619,10 @@ class BatchedRuntimeHandle:
             return fut
         with self._lock:
             if not self._promise_free:
-                fut.set_exception(RuntimeError("promise rows exhausted"))
+                self._stat_ask_exhausted += 1
+                fut.set_exception(AskPoolExhausted(
+                    f"promise rows exhausted ({self.promise_rows_n} in "
+                    f"flight; raise the dispatcher's promise-rows key)"))
                 return fut
             slot = self._promise_free.pop()
         prow = self._promise_base + slot
@@ -1111,6 +1128,24 @@ class BatchedRuntimeHandle:
                 "host_checks": self._stat_host_checks,
                 "dispatch_p50_us": pct(0.50),
                 "dispatch_p99_us": pct(0.99)}
+
+    def ask_pool_stats(self) -> Dict[str, Any]:
+        """Promise-pool occupancy: the admission signal for ask traffic.
+        `in_flight` counts claimed slots (waiters + quarantined zombies),
+        `exhausted` the typed AskPoolExhausted fast-fails so far, and
+        `occupancy` the claimed fraction — the gateway sheds above a
+        threshold on this BEFORE asks start fast-failing."""
+        with self._lock:
+            free = len(self._promise_free)
+            zombies = len(self._promise_zombies)
+            waiting = len(self._waiters)
+            exhausted = self._stat_ask_exhausted
+        size = self.promise_rows_n
+        in_flight = max(0, size - free)
+        return {"size": size, "free": free, "in_flight": in_flight,
+                "waiting": waiting, "zombies": zombies,
+                "exhausted": exhausted,
+                "occupancy": (in_flight / size) if size else 1.0}
 
     def sentinel_stats(self) -> Dict[str, Any]:
         """Detection-lane telemetry: drains observed, shards currently

@@ -386,7 +386,10 @@ class MeshSentinel:
                 fut.set_exception(SentinelHalted(self._halted))
                 return fut
             if not self._promise_free:
-                fut.set_exception(RuntimeError("promise rows exhausted"))
+                from .bridge import AskPoolExhausted
+                fut.set_exception(AskPoolExhausted(
+                    f"promise rows exhausted ({self.promise_rows_n} in "
+                    f"flight)"))
                 return fut
             slot = self._promise_free.pop()
             prow = self._promise_base + slot

@@ -65,6 +65,18 @@ class TpuBatchedDispatcher(Dispatcher):
                         c.get_string("checkpoint-dir", "") or None),
                     checkpoint_keep=overrides.get(
                         "checkpoint_keep", c.get_int("checkpoint-keep", 3)),
+                    # WAL group commit: the system-wide
+                    # akka.persistence.tell-journal.fsync-every-n key (or a
+                    # per-dispatcher wal-fsync-every-n / override) batches
+                    # journal fsyncs; 1 = per-record, bit-identical
+                    wal_fsync_every_n=overrides.get(
+                        "wal_fsync_every_n",
+                        c.get_int(
+                            "wal-fsync-every-n",
+                            getattr(system, "settings", None) and
+                            system.settings.config.get_int(
+                                "akka.persistence.tell-journal."
+                                "fsync-every-n", 1) or 1)),
                     sentinel_threshold=overrides.get(
                         "sentinel_threshold",
                         c.get_float("sentinel-threshold", 8.0)),

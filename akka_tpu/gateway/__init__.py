@@ -1,0 +1,21 @@
+"""Serving gateway: external traffic in, sharded entities on-device,
+SLOs out (ISSUE 8 tentpole; docs/SERVING_GATEWAY.md).
+
+Three planes, each its own module:
+- ingress:   framed-TCP front door + in-proc transport + RegionBackend
+- admission: per-tenant token buckets + runtime-pressure load shedding
+- slo:       p50/p99 latency vs targets, error budget, per-tenant counters
+"""
+
+from .admission import (AdmissionController, AskPoolExhausted, Reject,
+                        TokenBucket, handle_pressure_signals,
+                        region_pressure_signals)
+from .ingress import (GatewayClient, GatewayServer, RegionBackend,
+                      counter_behavior, encode_frame, FrameReader)
+from .slo import SloTracker
+
+__all__ = ["AdmissionController", "AskPoolExhausted", "Reject",
+           "TokenBucket", "handle_pressure_signals",
+           "region_pressure_signals", "GatewayClient", "GatewayServer",
+           "RegionBackend", "counter_behavior", "encode_frame",
+           "FrameReader", "SloTracker"]

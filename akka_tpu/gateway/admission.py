@@ -1,0 +1,227 @@
+"""Admission control for the serving gateway: token buckets + load shed.
+
+Two layers, both returning TYPED decisions (shed load is backpressure the
+client can act on — retry_after, reason — never a silent drop):
+
+1. Per-tenant token buckets (rate + burst): the fairness layer. One tenant
+   flooding the front door cannot starve the others; its excess is shed
+   with reason "rate_limited" while everyone else stays under SLO.
+
+2. Pressure-driven shedding: the protection layer. The runtime already
+   exposes every signal an overload shows up in FIRST — per-shard
+   `mailbox_overflow`/`dropped` counters in the packed attention word
+   (device mail being lost), bridge `pipeline_stats` (dispatch backlog),
+   and ask-pool occupancy (promise rows claimed by in-flight asks, the
+   typed `AskPoolExhausted` fast-fail when fully drained). The controller
+   polls them at `check_interval` (they are device/stats reads — never
+   per-request) and sheds with reason "overloaded:<signal>" while any
+   holds, plus a cooldown so recovery is hysteretic, not flappy.
+
+Reference shape: stream-level backpressure ends at the TCP edge; from
+there on, the gateway converts queue growth into explicit rejects the way
+Akka HTTP's `ServiceUnavailable` + Retry-After does, driven by the same
+kind of signals a mailbox-size-based `MailboxPressure` custom dispatcher
+would read.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+from ..batched.bridge import AskPoolExhausted
+
+__all__ = ["TokenBucket", "Reject", "AdmissionController",
+           "region_pressure_signals", "handle_pressure_signals",
+           "AskPoolExhausted"]
+
+
+class TokenBucket:
+    """Classic token bucket: `rate` tokens/s refill, `burst` capacity.
+    Lazy refill on acquire; monotonic clock injectable for tests."""
+
+    def __init__(self, rate: float, burst: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.clock = clock
+        self._tokens = float(burst)
+        self._last = clock()
+        self._lock = threading.Lock()
+
+    def try_acquire(self, n: float = 1.0) -> bool:
+        with self._lock:
+            now = self.clock()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last) * self.rate)
+            self._last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+    def retry_after(self, n: float = 1.0) -> float:
+        """Seconds until `n` tokens will be available (0 if now)."""
+        with self._lock:
+            missing = n - self._tokens
+        return max(0.0, missing / self.rate) if self.rate > 0 else 60.0
+
+
+@dataclass
+class Reject:
+    """Typed shed decision: the wire reply carries both fields, so shed
+    load is visible backpressure (reason + when to come back), never a
+    timeout the client must discover."""
+
+    reason: str
+    retry_after_s: float = 0.0
+
+
+class AdmissionController:
+    """admit(tenant) -> None (admitted) | Reject(reason, retry_after).
+
+    `pressure_signals` maps signal name -> zero-arg callable returning a
+    float; a signal above its threshold (same key in `thresholds`) sheds
+    ALL tenants with reason "overloaded:<name>" until it drops AND the
+    `cooldown_s` hysteresis window passes. Signals are polled at most
+    every `check_interval_s` — admission itself is lock + dict work.
+    """
+
+    def __init__(self, rate: float = 100.0, burst: float = 50.0,
+                 pressure_signals: Optional[Dict[str, Callable[[], float]]]
+                 = None,
+                 thresholds: Optional[Dict[str, float]] = None,
+                 check_interval_s: float = 0.05,
+                 cooldown_s: float = 0.25,
+                 clock: Callable[[], float] = time.monotonic,
+                 metrics_registry=None):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.clock = clock
+        self.pressure_signals = dict(pressure_signals or {})
+        self.thresholds = dict(thresholds or {})
+        self.check_interval_s = float(check_interval_s)
+        self.cooldown_s = float(cooldown_s)
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._lock = threading.Lock()
+        self._next_check = 0.0
+        self._overload_until = 0.0
+        self._overload_reason: Optional[str] = None
+        self._last_values: Dict[str, float] = {}
+        self.admitted = 0
+        self.rejected = 0
+        self.rejected_by_reason: Dict[str, int] = {}
+        self._registry = metrics_registry
+        if metrics_registry is not None:
+            metrics_registry.register_collector("gateway_admission",
+                                                self.stats)
+
+    # ------------------------------------------------------------- signals
+    def _poll_pressure(self, now: float) -> None:
+        self._next_check = now + self.check_interval_s
+        for name, fn in self.pressure_signals.items():
+            try:
+                v = float(fn())
+            except Exception:  # a dead signal must not take down ingress
+                continue
+            self._last_values[name] = v
+            if v > self.thresholds.get(name, float("inf")):
+                self._overload_until = now + self.cooldown_s
+                self._overload_reason = name
+
+    def note_ask_pool_exhausted(self) -> None:
+        """The backend fast-failed with AskPoolExhausted: treat it as an
+        instantly-observed pressure signal (no poll latency) and shed for
+        a cooldown window."""
+        now = self.clock()
+        with self._lock:
+            self._overload_until = max(self._overload_until,
+                                       now + self.cooldown_s)
+            self._overload_reason = "ask_pool_exhausted"
+
+    # -------------------------------------------------------------- admit
+    def admit(self, tenant: str) -> Optional[Reject]:
+        now = self.clock()
+        with self._lock:
+            if now >= self._next_check and self.pressure_signals:
+                self._poll_pressure(now)
+            if now < self._overload_until:
+                self.rejected += 1
+                reason = f"overloaded:{self._overload_reason}"
+                self.rejected_by_reason[reason] = \
+                    self.rejected_by_reason.get(reason, 0) + 1
+                return Reject(reason, round(self._overload_until - now, 3))
+            bucket = self._buckets.get(tenant)
+            if bucket is None:
+                bucket = self._buckets[tenant] = TokenBucket(
+                    self.rate, self.burst, self.clock)
+        if not bucket.try_acquire():
+            with self._lock:
+                self.rejected += 1
+                self.rejected_by_reason["rate_limited"] = \
+                    self.rejected_by_reason.get("rate_limited", 0) + 1
+            return Reject("rate_limited", round(bucket.retry_after(), 3))
+        with self._lock:
+            self.admitted += 1
+        return None
+
+    # -------------------------------------------------------------- stats
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            overloaded = self.clock() < self._overload_until
+            return {"admitted": self.admitted,
+                    "rejected": self.rejected,
+                    "overloaded": int(overloaded),
+                    "tenants": len(self._buckets),
+                    **{f"signal_{k}": v
+                       for k, v in self._last_values.items()}}
+
+
+# -------------------------------------------------- runtime pressure wiring
+def region_pressure_signals(region) -> Dict[str, Callable[[], float]]:
+    """Admission signals for a DeviceShardRegion backend.
+
+    | signal             | source                                   |
+    |--------------------|------------------------------------------|
+    | mailbox_overflow   | attention word mailbox_overflow (total)  |
+    | exchange_dropped   | attention word dropped (total)           |
+    | ask_pool_occupancy | region promise-slot occupancy            |
+
+    Overflow counters are CUMULATIVE: the signal is their GROWTH since
+    the previous poll (device mail being lost right now), so thresholds
+    compare against a per-interval delta, and a long-dead spike does not
+    shed forever."""
+    last = {"mail": 0.0, "drop": 0.0}
+
+    def mail_delta() -> float:
+        v = float(region.system.mailbox_overflow)
+        d, last["mail"] = v - last["mail"], v
+        return d
+
+    def drop_delta() -> float:
+        import numpy as np
+        v = float(np.sum(region.system.dropped_per_shard))
+        d, last["drop"] = v - last["drop"], v
+        return d
+
+    return {"mailbox_overflow": mail_delta,
+            "exchange_dropped": drop_delta,
+            "ask_pool_occupancy":
+                lambda: float(region.ask_pool_stats()["occupancy"])}
+
+
+def handle_pressure_signals(handle) -> Dict[str, Callable[[], float]]:
+    """Admission signals for a BatchedRuntimeHandle backend: pipeline
+    backlog (programs enqueued minus drained, vs configured depth) and
+    ask-pool occupancy."""
+
+    def backlog() -> float:
+        st = handle.pipeline_stats()
+        depth = max(1, int(st.get("depth", 1)))
+        return (st.get("steps", 0) - st.get("drains", 0)) / depth
+
+    return {"pipeline_backlog": backlog,
+            "ask_pool_occupancy":
+                lambda: float(handle.ask_pool_stats()["occupancy"])}

@@ -1,0 +1,356 @@
+"""Ingress: the framed-TCP front door onto sharded device entities.
+
+Wire protocol — `simpleFramingProtocol` (stream/framing.py): every frame
+is `[u32 big-endian length][JSON body]`. Requests:
+
+    {"id": 7, "tenant": "t0", "entity": "acct-42", "op": "add", "value": 3}
+
+ops: "add" (apply value, reply new total — the acknowledged write),
+"get" (read total). Replies:
+
+    {"id": 7, "status": "ok", "value": 45.0}
+    {"id": 8, "status": "shed", "reason": "rate_limited",
+     "retry_after_ms": 120}
+    {"id": 9, "status": "error", "reason": "timeout"}
+
+"shed" is the admission layer speaking (typed backpressure — the client
+knows why and when to retry); "error" is the runtime (ask timeout or
+fault). The operator tenant `__admin` bypasses admission and reaches
+control ops (sum / checkpoint / rebalance / failover / artifact / stats)
+through the same front door — chaos is injected over the wire, the way
+an operator would.
+
+Request path: TCP bytes -> length-field decode -> handle_frame (admission
+-> SLO clock -> backend ask) -> length-prefix encode -> TCP bytes. The
+per-connection flow is ack-gated by the stream TCP layer (ONE Write in
+flight), so a slow consumer throttles the producer instead of growing an
+unbounded buffer — tested in tests/test_gateway.py.
+
+`handle_frame` is transport-free: the tier-1 smoke test and the
+gateway-slo bench drive it in-proc; the chaos tier drives it over real
+sockets from other OS processes.
+
+Entity hosting: `RegionBackend` adapts a DeviceShardRegion — entities are
+rows on the mesh, requests are region asks (reply-to promise row in the
+payload's last column), writes are journaled tells (WAL) so acknowledged
+writes survive kill -9. The counter entity keeps the reduction
+COMMUTATIVE (the dense-inbox contract): "get" is add(0), and the reply is
+always the post-apply total.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..batched.bridge import AskPoolExhausted
+from .admission import AdmissionController, Reject
+from .slo import SloTracker
+
+__all__ = ["encode_frame", "FrameReader", "counter_behavior",
+           "RegionBackend", "GatewayServer", "GatewayClient"]
+
+ADMIN_TENANT = "__admin"
+
+
+# ---------------------------------------------------------------- wire codec
+def encode_frame(obj: Dict[str, Any]) -> bytes:
+    body = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    return struct.pack(">I", len(body)) + body
+
+
+class FrameReader:
+    """Incremental length-field frame reassembly for raw sockets (the
+    client half; servers reuse the stream Framing stages)."""
+
+    def __init__(self, max_frame: int = 1 << 20):
+        self._buf = bytearray()
+        self.max_frame = max_frame
+
+    def feed(self, data: bytes):
+        self._buf.extend(data)
+        while len(self._buf) >= 4:
+            n = struct.unpack(">I", self._buf[:4])[0]
+            if n > self.max_frame:
+                raise ValueError(f"frame of {n} bytes exceeds "
+                                 f"{self.max_frame}")
+            if len(self._buf) < 4 + n:
+                return
+            body = bytes(self._buf[4:4 + n])
+            del self._buf[:4 + n]
+            yield json.loads(body)
+
+
+# ------------------------------------------------------------ entity backend
+def counter_behavior(payload_width: int, out_degree: int = 1):
+    """The serving entity: an event-sourced additive counter. Payload
+    [value, ..., reply_row]; the reduction sums concurrent adds (the
+    dense-inbox commutative contract) and the reply is the new total,
+    emitted to the reply-to row (bridge ask convention)."""
+    import jax.numpy as jnp
+    from ..batched import Emit, behavior
+    from ..batched.bridge import reply_dst
+    P, k = payload_width, out_degree
+
+    @behavior("gw_counter", {"total": ((), jnp.float32)})
+    def counter(state, inbox, ctx):
+        got = inbox.count > 0
+        new_total = state["total"] + inbox.sum[0]
+        reply = jnp.zeros((P,), jnp.float32).at[0].set(new_total)
+        return ({"total": jnp.where(got, new_total, state["total"])},
+                Emit.single(reply_dst(inbox.sum), reply, k, P, when=got))
+
+    return counter
+
+
+class RegionBackend:
+    """Adapts a DeviceShardRegion of counter entities to the gateway:
+    ask(entity_id, value) -> new total (acknowledged = applied + WAL'd,
+    when the region has attach_journal'd)."""
+
+    def __init__(self, region, steps: int = 2, max_extra_steps: int = 16):
+        self.region = region
+        self.steps = steps
+        self.max_extra_steps = max_extra_steps
+
+    def ask(self, entity_id: str, value: float) -> float:
+        ref = self.region.entity_ref(entity_id)
+        reply = self.region.ask(ref.shard, ref.index, [float(value)],
+                                steps=self.steps,
+                                max_extra_steps=self.max_extra_steps)
+        return float(np.asarray(reply)[0])
+
+    def sum_all(self) -> float:
+        """Conserved-value probe: sum of every spawned entity's total."""
+        region = self.region
+        with region._ask_lock:  # quiesce vs concurrent asks/maintenance
+            return self._sum_locked(region)
+
+    @staticmethod
+    def _sum_locked(region) -> float:
+        region.block_until_ready()
+        rows = []
+        with region._lock:
+            for shard, ents in enumerate(region._entities):
+                base = int(region._shard_block[shard]) * region.eps
+                rows.extend(base + idx for idx in ents.values())
+        if not rows:
+            return 0.0
+        vals = region.system.read_state(
+            "total", np.asarray(sorted(rows), np.int32))
+        return float(np.asarray(vals, np.float64).sum())
+
+    def pressure_signals(self) -> Dict[str, Callable[[], float]]:
+        from .admission import region_pressure_signals
+        return region_pressure_signals(self.region)
+
+
+# ------------------------------------------------------------------- server
+class GatewayServer:
+    """The front door: admission -> SLO clock -> backend ask, over TCP
+    (stream layer) and/or in-proc frames (`handle_frame`)."""
+
+    def __init__(self, system, backend, admission: AdmissionController,
+                 slo: SloTracker, host: str = "127.0.0.1", port: int = 0,
+                 max_frame: int = 1 << 16):
+        self.system = system
+        self.backend = backend
+        self.admission = admission
+        self.slo = slo
+        self.host = host
+        self.port = port
+        self.max_frame = max_frame
+        self._binding = None
+        self._seq = 0
+
+    # ------------------------------------------------------------ transport
+    def start(self) -> Tuple[str, int]:
+        from ..stream.dsl import Keep, Sink
+        from ..stream.framing import Framing
+        from ..stream.tcp import Tcp
+        if self.port == 0:
+            with socket.socket() as s:
+                s.bind((self.host, 0))
+                self.port = s.getsockname()[1]
+        tcp = Tcp.get(self.system)
+
+        def handle(conn):
+            conn.handle_with(
+                Framing.simple_framing_protocol_decoder(self.max_frame)
+                .map(self.handle_frame)
+                .via(Framing.simple_framing_protocol_encoder(
+                    self.max_frame)),
+                self.system)
+
+        fut = tcp.bind(self.host, self.port) \
+            .to_mat(Sink.foreach(handle), Keep.left).run(self.system)
+        self._binding = fut.result(10.0)
+        return self.host, self.port
+
+    def stop(self) -> None:
+        if self._binding is not None:
+            self._binding.unbind()
+            self._binding = None
+
+    # ------------------------------------------------------------- requests
+    def handle_frame(self, frame: bytes) -> bytes:
+        try:
+            req = json.loads(frame)
+            rid = req.get("id", -1)
+            tenant = str(req["tenant"])
+            op = str(req["op"])
+        except Exception as e:  # malformed frame: typed error, keep serving
+            return encode_body({"id": -1, "status": "error",
+                                "reason": f"bad_request:{type(e).__name__}"})
+        if tenant == ADMIN_TENANT:
+            return encode_body(self._handle_admin(rid, op, req))
+
+        rej = self.admission.admit(tenant)
+        if rej is not None:
+            self.slo.record(tenant, "reject")
+            return encode_body(self._shed(rid, rej))
+        value = float(req.get("value", 0.0)) if op == "add" else 0.0
+        if op not in ("add", "get"):
+            self.slo.record(tenant, "error")
+            return encode_body({"id": rid, "status": "error",
+                                "reason": f"unknown_op:{op}"})
+        t0 = time.perf_counter()
+        try:
+            total = self.backend.ask(str(req["entity"]), value)
+        except AskPoolExhausted:
+            # the typed fast-fail the admission layer sheds on: convert to
+            # a shed reply AND arm the controller's cooldown
+            self.admission.note_ask_pool_exhausted()
+            self.slo.record(tenant, "reject")
+            return encode_body(self._shed(
+                rid, Reject("ask_pool_exhausted",
+                            self.admission.cooldown_s)))
+        except TimeoutError:
+            self.slo.record(tenant, "timeout",
+                            time.perf_counter() - t0)
+            return encode_body({"id": rid, "status": "error",
+                                "reason": "timeout"})
+        except Exception as e:  # noqa: BLE001 — fault isolation per request
+            self.slo.record(tenant, "error")
+            return encode_body({"id": rid, "status": "error",
+                                "reason": f"fault:{type(e).__name__}"})
+        self.slo.record(tenant, "ok", time.perf_counter() - t0)
+        return encode_body({"id": rid, "status": "ok", "value": total})
+
+    @staticmethod
+    def _shed(rid, rej: Reject) -> Dict[str, Any]:
+        return {"id": rid, "status": "shed", "reason": rej.reason,
+                "retry_after_ms": int(rej.retry_after_s * 1e3)}
+
+    # ---------------------------------------------------------------- admin
+    def _handle_admin(self, rid, op: str, req: Dict[str, Any]) \
+            -> Dict[str, Any]:
+        """Operator channel (not admission-gated): chaos legs and probes
+        ride the same wire as traffic."""
+        try:
+            if op == "sum":
+                return {"id": rid, "status": "ok",
+                        "value": self.backend.sum_all()}
+            if op == "artifact":
+                return {"id": rid, "status": "ok",
+                        "data": self.slo.artifact()}
+            if op == "stats":
+                return {"id": rid, "status": "ok",
+                        "data": {"admission": self.admission.stats(),
+                                 "region": self.backend.region.stats(),
+                                 "ask_pool":
+                                     self.backend.region.ask_pool_stats()}}
+            if op == "checkpoint":
+                return {"id": rid, "status": "ok",
+                        "data": {"path": self.backend.region.checkpoint()}}
+            if op == "rebalance":
+                shard = int(req.get("value", 0))
+                blk = self.backend.region.rebalance(shard)
+                return {"id": rid, "status": "ok", "value": float(blk)}
+            if op == "failover":
+                import jax
+                n = int(req.get("value", 1))
+                step = self.backend.region.failover(jax.devices()[:n])
+                return {"id": rid, "status": "ok", "value": float(step)}
+            return {"id": rid, "status": "error",
+                    "reason": f"unknown_admin_op:{op}"}
+        except Exception as e:  # noqa: BLE001 — admin faults must reply
+            return {"id": rid, "status": "error",
+                    "reason": f"admin_fault:{type(e).__name__}:{e}"}
+
+
+def encode_body(obj: Dict[str, Any]) -> bytes:
+    """Reply body only — the stream encoder stage (or the in-proc caller)
+    adds the length prefix."""
+    return json.dumps(obj, separators=(",", ":")).encode("utf-8")
+
+
+# ------------------------------------------------------------------- client
+class GatewayClient:
+    """Blocking raw-socket client (tests / load generators / example).
+    One request in flight per connection; `request` returns the decoded
+    reply dict. `request_retry` reconnects through server restarts — the
+    chaos legs' client behavior."""
+
+    def __init__(self, host: str, port: int, timeout: float = 15.0):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._reader = FrameReader()
+        self._seq = 0
+
+    def connect(self) -> None:
+        self.close()
+        s = socket.create_connection((self.host, self.port),
+                                     timeout=self.timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = s
+        self._reader = FrameReader()
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def request(self, tenant: str, entity: str, op: str,
+                value: float = 0.0) -> Dict[str, Any]:
+        if self._sock is None:
+            self.connect()
+        self._seq += 1
+        req = {"id": self._seq, "tenant": tenant, "entity": entity,
+               "op": op, "value": value}
+        self._sock.sendall(encode_frame(req))
+        while True:
+            data = self._sock.recv(65536)
+            if not data:
+                raise ConnectionError("gateway closed the connection")
+            for reply in self._reader.feed(data):
+                return reply
+
+    def request_retry(self, tenant: str, entity: str, op: str,
+                      value: float = 0.0, deadline_s: float = 60.0,
+                      pause_s: float = 0.2) -> Dict[str, Any]:
+        """Retry through connection failures (server crash/restart) until
+        `deadline_s`. Shed replies are returned to the caller — backoff
+        on rejects is a POLICY, reconnection is plumbing."""
+        deadline = time.monotonic() + deadline_s
+        last: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                return self.request(tenant, entity, op, value)
+            except (OSError, ConnectionError, socket.timeout) as e:
+                last = e
+                self.close()
+                time.sleep(pause_s)
+        raise TimeoutError(f"gateway unreachable for {deadline_s}s: {last!r}")
+
+    def admin(self, op: str, value: float = 0.0) -> Dict[str, Any]:
+        return self.request(ADMIN_TENANT, "", op, value)

@@ -1,0 +1,136 @@
+"""SLO tracking for the serving gateway, on the unified telemetry plane.
+
+The tracker is a thin, hot-path-cheap layer over the existing
+MetricsRegistry (event/metrics.py): per-outcome counters (ok / reject /
+timeout / error, globally and per tenant), a log-bucket latency histogram
+for p50/p99 against configured targets, and an error budget — all
+step-stamped on the shared `ATT_STEP` axis via `registry.set_step`, so a
+latency regression lines up against the same device step as the pipeline
+and sentinel collectors.
+
+`artifact()` is the stable JSON schema the bench, the watchdog row and
+the chaos integration test all emit/assert (docs/SERVING_GATEWAY.md):
+
+    {"requests", "ok", "rejects", "timeouts", "errors",
+     "p50_ms", "p99_ms", "target_p50_ms", "target_p99_ms",
+     "p50_met", "p99_met", "reject_rate",
+     "slo_target", "error_budget_total", "error_budget_spent",
+     "error_budget_remaining", "step", "per_tenant": {tenant: {...}}}
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import deque
+from typing import Any, Dict, Optional
+
+__all__ = ["SloTracker"]
+
+_OUTCOMES = ("ok", "reject", "timeout", "error")
+
+
+class SloTracker:
+    """record(tenant, outcome, latency_s) on every request; artifact()
+    for the SLO report. Registered as the "gateway" collector when a
+    registry is supplied (gauges: akka_gateway_requests, _p99_ms, ...).
+
+    The error budget follows the SRE convention: with `slo_target`
+    success (default 99%), budget = (1 - slo_target) of requests may go
+    bad (timeout/error — REJECTS ARE NOT SLO VIOLATIONS: shed load is the
+    mechanism protecting the SLO, and it is reported separately as
+    reject_rate)."""
+
+    def __init__(self, registry=None,
+                 target_p50_ms: float = 50.0,
+                 target_p99_ms: float = 250.0,
+                 slo_target: float = 0.99,
+                 window: int = 8192):
+        self.registry = registry
+        self.target_p50_ms = float(target_p50_ms)
+        self.target_p99_ms = float(target_p99_ms)
+        self.slo_target = float(slo_target)
+        self._lock = threading.Lock()
+        self._counts = {o: 0 for o in _OUTCOMES}
+        self._per_tenant: Dict[str, Dict[str, int]] = {}
+        # sliding latency window (ms) + sorted-snapshot cache keyed on the
+        # append counter, the pipeline_stats idiom: percentile pulls at
+        # exposition time must not re-sort an unchanged window
+        self._lat_ms: deque = deque(maxlen=int(window))
+        self._lat_seq = 0
+        self._lat_sorted = (-1, [])
+        self._hist = None
+        if registry is not None:
+            registry.register_collector("gateway", self._collect)
+            self._hist = registry.histogram(
+                "gateway_ask_latency_ms",
+                "gateway request latency (admitted asks), milliseconds")
+
+    # -------------------------------------------------------------- record
+    def record(self, tenant: str, outcome: str,
+               latency_s: Optional[float] = None) -> None:
+        if outcome not in _OUTCOMES:
+            raise ValueError(f"unknown outcome {outcome!r}")
+        with self._lock:
+            self._counts[outcome] += 1
+            per = self._per_tenant.get(tenant)
+            if per is None:
+                per = self._per_tenant[tenant] = {o: 0 for o in _OUTCOMES}
+            per[outcome] += 1
+            if latency_s is not None:
+                self._lat_ms.append(latency_s * 1e3)
+                self._lat_seq += 1
+        if self._hist is not None and latency_s is not None:
+            step = self.registry.step if self.registry is not None else None
+            self._hist.observe(latency_s * 1e3, step=step)
+
+    # ---------------------------------------------------------- percentiles
+    def percentile(self, q: float) -> float:
+        """Nearest-rank percentile (ms) over the sliding window."""
+        with self._lock:
+            seq, d = self._lat_sorted
+            if seq != self._lat_seq:
+                d = sorted(self._lat_ms)
+                self._lat_sorted = (self._lat_seq, d)
+        if not d:
+            return 0.0
+        return d[max(math.ceil(q * len(d)) - 1, 0)]
+
+    # -------------------------------------------------------------- report
+    def artifact(self) -> Dict[str, Any]:
+        with self._lock:
+            counts = dict(self._counts)
+            per_tenant = {t: dict(c) for t, c in self._per_tenant.items()}
+        total = sum(counts.values())
+        bad = counts["timeout"] + counts["error"]
+        served = counts["ok"] + bad  # admitted traffic (SLO denominator)
+        budget_total = (1.0 - self.slo_target) * served
+        p50, p99 = self.percentile(0.50), self.percentile(0.99)
+        step = self.registry.step if self.registry is not None else 0
+        return {
+            "requests": total,
+            "ok": counts["ok"],
+            "rejects": counts["reject"],
+            "timeouts": counts["timeout"],
+            "errors": counts["error"],
+            "p50_ms": round(p50, 3),
+            "p99_ms": round(p99, 3),
+            "target_p50_ms": self.target_p50_ms,
+            "target_p99_ms": self.target_p99_ms,
+            "p50_met": int(p50 <= self.target_p50_ms),
+            "p99_met": int(p99 <= self.target_p99_ms),
+            "reject_rate": round(counts["reject"] / total, 4) if total else 0.0,
+            "slo_target": self.slo_target,
+            "error_budget_total": round(budget_total, 3),
+            "error_budget_spent": bad,
+            "error_budget_remaining": round(budget_total - bad, 3),
+            "step": int(step),
+            "per_tenant": per_tenant,
+        }
+
+    def _collect(self) -> Dict[str, float]:
+        """Numeric slice of artifact() for the registry (per_tenant and
+        target echoes stay in the JSON artifact)."""
+        art = self.artifact()
+        return {k: float(v) for k, v in art.items()
+                if isinstance(v, (int, float))}

@@ -30,6 +30,8 @@ recompile behaviors.
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
@@ -152,9 +154,19 @@ class DeviceShardRegion:
         # (the late reply landed), then returned to the free list
         self._promise_retired: List[int] = []
         self._promise_spawned = False
+        self._stat_ask_exhausted = 0  # typed AskPoolExhausted fast-fails
         self._lock = threading.Lock()
-        self._ask_lock = threading.Lock()  # asks serialize (stepping API)
+        # asks AND maintenance ops (checkpoint/rebalance/failover/restore)
+        # serialize: all of them step or swap the shared runtime. Reentrant
+        # because rebalance checkpoints under its own hold.
+        self._ask_lock = threading.RLock()
         self._stray_steps_left = 0         # hand-off drain window
+        # durability (attach_journal): WAL + slab snapshots + the placement
+        # sidecar make the region restorable in a fresh process and
+        # rebuildable on a survivor mesh (failover)
+        self.checkpoint_dir: Optional[str] = None
+        self._journal = None
+        self._ents_fh = None
 
         # entity registry: per-shard entity_id -> index (remember-entities)
         self._entities: List[Dict[str, int]] = [dict()
@@ -230,7 +242,11 @@ class DeviceShardRegion:
             sys = self.system
             with self._lock:
                 if not self._promise_free:
-                    raise RuntimeError("promise rows exhausted")
+                    from ..batched.bridge import AskPoolExhausted
+                    self._stat_ask_exhausted += 1
+                    raise AskPoolExhausted(
+                        f"promise rows exhausted ({self.eps} slots, "
+                        f"{len(self._promise_retired)} retired)")
                 slot = self._promise_free.pop()
             prow = self._promise_block * self.eps + slot
             if prow > max_exact_row_id(sys.payload_dtype):
@@ -327,6 +343,9 @@ class DeviceShardRegion:
                     raise RuntimeError(
                         f"shard {shard} full ({self.eps} entities)")
                 self._entities[shard][entity_id] = idx
+                if getattr(self, "_ents_fh", None) is not None:
+                    self._ents_fh.write(f"{shard}\t{idx}\t{entity_id}\n")
+                    self._ents_fh.flush()
         self._ensure_spawned(shard, idx)
         return DeviceEntityRef(self, shard, idx, entity_id)
 
@@ -382,6 +401,11 @@ class DeviceShardRegion:
         messages addressed into the old block are re-pointed).
 
         Returns the new physical block index."""
+        with self._ask_lock:
+            return self._rebalance_locked(shard, to_device)
+
+    def _rebalance_locked(self, shard: int,
+                          to_device: Optional[int] = None) -> int:
         lease = self.spec.lease
         if lease is not None and not lease.acquire():
             raise RuntimeError(
@@ -431,6 +455,16 @@ class DeviceShardRegion:
                 (d + delta if old.start <= d < old.stop else d, t, p)
                 for d, t, p in sys._host_staged]
         self._sync_tables()
+        if self.checkpoint_dir is not None:
+            # the WAL records tells, not placement moves: drain the
+            # hand-off window and snapshot NOW, so recovery never replays
+            # post-move traffic onto pre-move block homes (and never
+            # snapshots the stray-mode inbox layout)
+            guard = 64  # bounded: each pass forwards strays one hop
+            while self._stray_steps_left > 0 and guard > 0:
+                guard -= self._stray_steps_left
+                self.run(self._stray_steps_left)
+            self.checkpoint()
         return new_block
 
     # ----------------------------------------------------------------- stats
@@ -445,6 +479,219 @@ class DeviceShardRegion:
                 "entities": int(self._spawned.sum()),
                 "entities_per_device": per_device,
                 "free_blocks": list(self._free_blocks)}
+
+    def ask_pool_stats(self) -> Dict[str, Any]:
+        """Promise-slot occupancy for this region's ask block (the
+        admission signal — see BatchedRuntimeHandle.ask_pool_stats).
+        `retired` slots are quarantined timeouts still counted in-flight;
+        `exhausted` counts typed AskPoolExhausted fast-fails."""
+        with self._lock:
+            free = len(self._promise_free)
+            retired = len(self._promise_retired)
+            exhausted = self._stat_ask_exhausted
+        size = self.eps
+        in_flight = max(0, size - free)
+        return {"size": size, "free": free, "in_flight": in_flight,
+                "retired": retired, "exhausted": exhausted,
+                "occupancy": (in_flight / size) if size else 1.0}
+
+    # ----------------------------------------------------- durability/failover
+    def attach_journal(self, directory: str,
+                       fsync_every_n: int = 1):
+        """Arm the write-ahead tell journal + checkpoint directory: every
+        staged tell journals BEFORE enqueue (zero lost acknowledged writes
+        across kill -9 — append flushes per record; fsync batches by
+        `fsync_every_n`, the akka.persistence.tell-journal.fsync-every-n
+        group-commit knob). checkpoint()/restore()/failover() need this."""
+        from ..persistence.tell_journal import TellJournal
+        os.makedirs(directory, exist_ok=True)
+        self.checkpoint_dir = directory
+        self._journal = TellJournal(
+            os.path.join(directory, "tells.wal"),
+            flight_recorder=getattr(self.system, "flight_recorder", None),
+            fsync_every_n=fsync_every_n)
+        self.system.tell_journal = self._journal
+        # first-touch entity allocations are WAL'd too (remember-entities
+        # durability): a tell journaled to an entity allocated AFTER the
+        # last snapshot must find its row alive on replay. One line per
+        # allocation, flushed — same process-crash guarantee as the tell
+        # WAL's flush-per-append.
+        self._ents_fh = open(os.path.join(directory, "entities.log"), "a")
+        return self._journal
+
+    def _sidecar_path(self) -> str:
+        return os.path.join(self.checkpoint_dir, "region.json")
+
+    def _write_sidecar(self) -> None:
+        """Placement + entity registry next to the slab snapshot. The slab
+        holds state/alive/behavior_id by ROW; this records which logical
+        shard owns which block and which entity_id owns which row — the
+        host half a fresh process cannot rederive."""
+        with self._lock:
+            doc = {"shard_block": [int(b) for b in self._shard_block],
+                   "free_blocks": list(self._free_blocks),
+                   "promise_block": int(self._promise_block),
+                   "promise_spawned": bool(self._promise_spawned),
+                   "promise_free": list(self._promise_free),
+                   "promise_retired": list(self._promise_retired),
+                   "entities": [dict(d) for d in self._entities],
+                   "spawned": [int(s) for s in self._spawned]}
+        tmp = self._sidecar_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._sidecar_path())
+
+    def checkpoint(self, keep: int = 3) -> str:
+        """Quiescent-barrier slab snapshot + placement sidecar + WAL
+        compaction (ShardedBatchedSystem.checkpoint underneath)."""
+        if self.checkpoint_dir is None:
+            raise RuntimeError("attach_journal(directory) before checkpoint")
+        with self._ask_lock:
+            path = self.system.checkpoint(self.checkpoint_dir, keep=keep)
+            self._write_sidecar()
+        # allocations up to here are covered by the sidecar: reset the log
+        if self._ents_fh is not None:
+            self._ents_fh.close()
+            self._ents_fh = open(
+                os.path.join(self.checkpoint_dir, "entities.log"), "w")
+        return path
+
+    def restore(self) -> int:
+        """Crash recovery in a fresh process: build an identically-spec'd
+        region, attach_journal(same dir), then restore() — loads the
+        placement sidecar, re-points the device tables, restores the
+        latest slab snapshot and replays the WAL to the crash frontier.
+        Returns the recovered host step counter."""
+        from ..persistence.slab_snapshot import latest_slab_path
+        if self.checkpoint_dir is None:
+            raise RuntimeError("attach_journal(directory) before restore")
+        with self._ask_lock:
+            path = latest_slab_path(self.checkpoint_dir)
+            if path is None:
+                raise FileNotFoundError(
+                    f"no slab snapshot under {self.checkpoint_dir}")
+            with open(self._sidecar_path()) as f:
+                doc = json.load(f)
+            self._load_sidecar(doc)
+            self._merge_entity_log()
+            self._sync_tables()  # tables feed the replayed steps
+            return self._restore_and_replay(path)
+
+    def _merge_entity_log(self) -> None:
+        """Fold entities.log into the registry: allocations since the last
+        sidecar write (idempotent — checkpoint truncates the log after the
+        sidecar covers it, so duplicates only appear across a crash in
+        between)."""
+        path = os.path.join(self.checkpoint_dir, "entities.log")
+        if not os.path.exists(path):
+            return
+        with open(path) as f:
+            for line in f:
+                parts = line.rstrip("\n").split("\t")
+                if len(parts) != 3:
+                    continue  # torn tail of a crashed append
+                shard, idx = int(parts[0]), int(parts[1])
+                with self._lock:
+                    self._entities[shard].setdefault(parts[2], idx)
+                    self._spawned[shard] = max(int(self._spawned[shard]),
+                                               idx + 1)
+
+    def _restore_and_replay(self, path: str) -> int:
+        """Slab restore, then host-side row re-activation, THEN the WAL
+        replay — replayed tells to entities allocated after the snapshot
+        must find their rows alive — then a 2-step flush so the crash-
+        frontier batch is applied to state, not just re-staged."""
+        from ..persistence.tell_journal import replay_journal
+        sys = self.system
+        step = sys.restore(path, journal=None)
+        self._reactivate_rows()
+        if self._journal is not None:
+            step = replay_journal(sys, self._journal)
+        sys.run(2)
+        sys.block_until_ready()
+        return step
+
+    def _reactivate_rows(self) -> None:
+        import jax.numpy as jnp_
+        sys = self.system
+        rows: List[int] = []
+        with self._lock:
+            for shard in range(self.spec.n_shards):
+                base = int(self._shard_block[shard]) * self.eps
+                rows.extend(range(base, base + int(self._spawned[shard])))
+        if rows:
+            idx = jnp_.asarray(np.asarray(rows, np.int32))
+            sys.behavior_id = sys.behavior_id.at[idx].set(0)
+            sys.alive = sys.alive.at[idx].set(True)
+        with self._lock:
+            if self._promise_spawned:
+                pbase = self._promise_block * self.eps
+                pidx = jnp_.arange(pbase, pbase + self.eps, dtype=jnp_.int32)
+                sys.behavior_id = sys.behavior_id.at[pidx].set(
+                    len(sys.behaviors) - 1)
+                sys.alive = sys.alive.at[pidx].set(True)
+
+    def _load_sidecar(self, doc: Dict[str, Any]) -> None:
+        with self._lock:
+            self._shard_block = np.asarray(doc["shard_block"], np.int32)
+            self._free_blocks = [int(b) for b in doc["free_blocks"]]
+            self._promise_block = int(doc["promise_block"])
+            self._promise_spawned = bool(doc["promise_spawned"])
+            self._promise_free = [int(s) for s in doc["promise_free"]]
+            self._promise_retired = [int(s) for s in doc["promise_retired"]]
+            self._entities = [{str(k): int(v) for k, v in d.items()}
+                              for d in doc["entities"]]
+            self._spawned = np.asarray(doc["spawned"], np.int32)
+
+    def failover(self, survivors: Sequence[Any]) -> int:
+        """Evict lost devices and rebuild the region on the survivor mesh
+        from the latest snapshot + WAL — the MeshSentinel force-evict
+        recipe applied to the sharded-entity region. The placement table
+        is row-space (device-independent), so shard homes, entity rows and
+        the promise block all survive; only blocks_per_device changes.
+        Requires total_blocks divisible by the survivor count (the mesh
+        stripes the row space evenly). Returns the recovered step."""
+        with self._ask_lock:
+            return self._failover_locked(survivors)
+
+    def _failover_locked(self, survivors: Sequence[Any]) -> int:
+        from ..parallel.mesh import make_mesh
+        from ..persistence.slab_snapshot import latest_slab_path
+        if self.checkpoint_dir is None:
+            raise RuntimeError("attach_journal(directory) before failover")
+        n_surv = len(survivors)
+        if n_surv < 1 or self.total_blocks % n_surv:
+            raise RuntimeError(
+                f"cannot re-stripe {self.total_blocks} blocks over "
+                f"{n_surv} survivors")
+        path = latest_slab_path(self.checkpoint_dir)
+        if path is None:
+            raise FileNotFoundError(
+                f"no slab snapshot under {self.checkpoint_dir}")
+        old = self.system
+        old_journal = self._journal
+        spec = self.spec
+        mesh = make_mesh(devices=list(survivors), axis_name=old.axis)
+        new = ShardedBatchedSystem(
+            capacity=old.capacity,
+            behaviors=[spec.behavior, *spec.extra_behaviors,
+                       self._promise_behavior(spec)],
+            mesh=mesh, n_devices=n_surv,
+            payload_width=spec.payload_width, out_degree=spec.out_degree,
+            host_inbox_per_shard=spec.host_inbox_per_shard,
+            mailbox_slots=spec.mailbox_slots,
+            reroute_strays=True)
+        new.flight_recorder = getattr(old, "flight_recorder", None)
+        self.n_devices = n_surv
+        self.blocks_per_device = self.total_blocks // n_surv
+        self._stray_steps_left = 0
+        self.system = new
+        self._sync_tables()  # before replay: behaviors read shard_row_base
+        step = self._restore_and_replay(path)
+        new.tell_journal = old_journal  # re-arm AFTER replay (no re-journal)
+        return step
 
     # ------------------------------------------------------------------ run
     def run(self, n_steps: int = 1) -> None:

@@ -852,6 +852,66 @@ def bench_failover(n, steps=48, directory=None):
     }
 
 
+def bench_gateway_slo(n_requests: int = 400, n_entities: int = 16):
+    """gateway-slo: sustained request load through the serving gateway's
+    in-proc ingress path (handle_frame -> admission -> region ask), two
+    legs sharing one region:
+
+    - below_threshold: admission wide open — every request admitted; the
+      p50/p99 here is the serving-latency artifact (SLO tracker window).
+    - overload: a tight token bucket — the admission layer must SHED
+      (reject_rate > 0, typed replies) instead of queueing into timeouts.
+
+    The JSON row carries both legs plus `shed_working` (rejects at
+    overload AND ~none below threshold); host load stamps ride the
+    artifact's shared `extra.host` block."""
+    import jax
+
+    from akka_tpu.gateway import (AdmissionController, GatewayServer,
+                                  RegionBackend, SloTracker,
+                                  counter_behavior)
+    from akka_tpu.sharding.device import DeviceEntity, DeviceShardRegion
+
+    spec = DeviceEntity("bench_gw", counter_behavior(4), n_shards=4,
+                        entities_per_shard=64,
+                        n_devices=min(2, len(jax.devices())),
+                        payload_width=4)
+    region = DeviceShardRegion(spec)
+    backend = RegionBackend(region)
+
+    def leg(rate, burst, n):
+        slo = SloTracker(target_p50_ms=50.0, target_p99_ms=250.0)
+        adm = AdmissionController(
+            rate=rate, burst=burst,
+            pressure_signals=backend.pressure_signals(),
+            thresholds={"ask_pool_occupancy": 0.95})
+        srv = GatewayServer(None, backend, adm, slo)
+        t0 = time.perf_counter()
+        for i in range(n):
+            body = json.dumps(
+                {"id": i, "tenant": f"t{i % 4}",
+                 "entity": f"acct-{i % n_entities}",
+                 "op": "add", "value": float(i % 5 + 1)}).encode()
+            srv.handle_frame(body)
+        dt = time.perf_counter() - t0
+        art = slo.artifact()
+        return {"requests": n, "wall_s": round(dt, 3),
+                "req_per_sec": round(n / dt, 1),
+                "p50_ms": art["p50_ms"], "p99_ms": art["p99_ms"],
+                "ok": art["ok"], "rejects": art["rejects"],
+                "reject_rate": art["reject_rate"]}
+
+    below = leg(rate=1e9, burst=1e9, n=n_requests)
+    # buckets are PER TENANT (4 tenants in the mix): size the bucket so
+    # the aggregate budget is well under the request count
+    over = leg(rate=4.0, burst=4.0, n=n_requests)
+    # conservation cross-check: every ok-acknowledged add is in the state
+    total = backend.sum_all()
+    return {"below_threshold": below, "overload": over,
+            "entities_total": round(total, 1),
+            "shed_working": over["rejects"] > 0 and below["rejects"] == 0}
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny config, CPU-ok")
@@ -865,7 +925,8 @@ def main() -> None:
                                          "bridge-latency", "modes",
                                          "supervision", "checkpoint-overhead",
                                          "metrics-overhead",
-                                         "failover-mttr", "spawn", "stream"],
+                                         "failover-mttr", "gateway-slo",
+                                         "spawn", "stream"],
                     help="run a single config (spawn/stream are extra "
                          "JMH-analogue microbenches outside the default "
                          "10-config surface)")
@@ -1100,6 +1161,22 @@ def main() -> None:
                     "unit": "s",
                     "vs_baseline": out.get("mttr_over_restore") or 0.0,
                     "extra": {"failover": out, **extra}}))
+            elif args.config == "gateway-slo":
+                gw_n = 120 if args.smoke else 400
+                out = bench_gateway_slo(gw_n)
+                b, o = out["below_threshold"], out["overload"]
+                print(f"[bench] gateway-slo: p50={b['p50_ms']}ms "
+                      f"p99={b['p99_ms']}ms @{b['req_per_sec']}req/s | "
+                      f"overload reject_rate={o['reject_rate']} "
+                      f"shed={'OK' if out['shed_working'] else 'FAIL'}",
+                      file=sys.stderr)
+                print(json.dumps({
+                    "metric": "gateway serving latency p99, sustained load "
+                              "(in-proc ingress, admission+SLO on)"
+                              + scale_tag,
+                    "value": b["p99_ms"], "unit": "ms",
+                    "vs_baseline": 1.0,
+                    "extra": {"gateway": out, **extra}}))
             elif args.config == "modes":
                 out = bench_modes(n, mode_steps)
                 best = max(r["msgs_per_sec"] for r in out.values()

@@ -1,0 +1,505 @@
+"""Serving gateway (akka_tpu/gateway): admission, SLO tracking, framed-TCP
+ingress onto sharded device entities, the typed AskPoolExhausted fast-fail,
+and the tell-WAL group-commit knob.
+
+Tier-1 scope: unit tests run hostside; the in-proc smoke drives the real
+handle_frame -> region-ask path on the virtual CPU mesh; the TCP tests use
+a real loopback socket through the stream layer. The multi-process chaos
+tier lives in tests/test_gateway_chaos.py (slow)."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from akka_tpu import ActorSystem
+from akka_tpu.gateway import (AdmissionController, AskPoolExhausted,
+                              FrameReader, GatewayClient, GatewayServer,
+                              Reject, RegionBackend, SloTracker, TokenBucket,
+                              counter_behavior, encode_frame)
+from akka_tpu.gateway.ingress import encode_body
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------------------------------------------------------- admission
+def test_token_bucket_burst_then_refill():
+    clk = FakeClock()
+    b = TokenBucket(rate=10.0, burst=3.0, clock=clk)
+    assert [b.try_acquire() for _ in range(4)] == [True, True, True, False]
+    clk.advance(0.1)  # one token refilled
+    assert b.try_acquire()
+    assert not b.try_acquire()
+    clk.advance(100.0)  # refill caps at burst
+    assert [b.try_acquire() for _ in range(4)] == [True, True, True, False]
+
+
+def test_token_bucket_retry_after():
+    clk = FakeClock()
+    b = TokenBucket(rate=2.0, burst=1.0, clock=clk)
+    assert b.try_acquire()
+    # 1 token missing at 2/s -> 0.5s
+    assert b.retry_after() == pytest.approx(0.5)
+
+
+def test_admission_rate_limit_is_per_tenant():
+    clk = FakeClock()
+    a = AdmissionController(rate=0.0, burst=2.0, clock=clk)
+    assert a.admit("t0") is None
+    assert a.admit("t0") is None
+    rej = a.admit("t0")
+    assert isinstance(rej, Reject) and rej.reason == "rate_limited"
+    # t1 has its own bucket: t0 flooding does not starve it
+    assert a.admit("t1") is None
+    assert a.rejected_by_reason == {"rate_limited": 1}
+    assert a.admitted == 3
+
+
+def test_admission_pressure_shed_and_cooldown_recovery():
+    clk = FakeClock()
+    sig = {"v": 0.0}
+    a = AdmissionController(rate=1e9, burst=1e9,
+                            pressure_signals={"boom": lambda: sig["v"]},
+                            thresholds={"boom": 1.0},
+                            check_interval_s=0.0, cooldown_s=5.0, clock=clk)
+    assert a.admit("t0") is None
+    sig["v"] = 2.0  # above threshold: everyone sheds, typed reason
+    rej = a.admit("t0")
+    assert rej is not None and rej.reason == "overloaded:boom"
+    assert rej.retry_after_s > 0
+    assert a.admit("other-tenant") is not None  # shed is global
+    sig["v"] = 0.0
+    clk.advance(1.0)  # signal recovered but cooldown (hysteresis) holds
+    assert a.admit("t0") is not None
+    clk.advance(10.0)
+    assert a.admit("t0") is None
+    st = a.stats()
+    assert st["overloaded"] == 0 and st["signal_boom"] == 0.0
+
+
+def test_admission_ask_pool_exhausted_arms_cooldown():
+    clk = FakeClock()
+    a = AdmissionController(rate=1e9, burst=1e9, cooldown_s=2.0, clock=clk)
+    assert a.admit("t0") is None
+    a.note_ask_pool_exhausted()  # instantly observed, no poll latency
+    rej = a.admit("t0")
+    assert rej is not None and rej.reason == "overloaded:ask_pool_exhausted"
+    clk.advance(3.0)
+    assert a.admit("t0") is None
+
+
+def test_admission_dead_signal_does_not_take_down_ingress():
+    def boom():
+        raise RuntimeError("collector died")
+
+    a = AdmissionController(rate=1e9, burst=1e9,
+                            pressure_signals={"dead": boom},
+                            thresholds={"dead": 0.0}, check_interval_s=0.0)
+    assert a.admit("t0") is None
+
+
+# --------------------------------------------------------------- wire codec
+def test_frame_codec_roundtrip_and_partials():
+    msgs = [{"id": i, "op": "add", "value": float(i)} for i in range(5)]
+    blob = b"".join(encode_frame(m) for m in msgs)
+    # byte-at-a-time reassembly
+    r = FrameReader()
+    out = []
+    for i in range(len(blob)):
+        out.extend(r.feed(blob[i:i + 1]))
+    assert out == msgs
+    # all frames in one feed
+    assert list(FrameReader().feed(blob)) == msgs
+
+
+def test_frame_reader_rejects_oversize_frame():
+    r = FrameReader(max_frame=16)
+    with pytest.raises(ValueError, match="exceeds"):
+        list(r.feed(encode_frame({"pad": "x" * 64})))
+
+
+# ---------------------------------------------------------------------- slo
+def test_slo_tracker_artifact_schema_and_budget():
+    slo = SloTracker(target_p50_ms=100.0, target_p99_ms=100.0,
+                     slo_target=0.9)
+    for ms in (10, 20, 30, 40):
+        slo.record("t0", "ok", latency_s=ms / 1e3)
+    slo.record("t1", "reject")
+    slo.record("t0", "timeout", latency_s=5.0)
+    art = slo.artifact()
+    assert art["requests"] == 6 and art["ok"] == 4
+    assert art["rejects"] == 1 and art["timeouts"] == 1
+    # rejects are NOT SLO violations: budget denominator is served traffic
+    assert art["error_budget_total"] == pytest.approx(0.1 * 5)
+    assert art["error_budget_spent"] == 1
+    assert art["reject_rate"] == pytest.approx(1 / 6, abs=1e-3)
+    assert art["per_tenant"]["t0"]["ok"] == 4
+    assert art["per_tenant"]["t1"]["reject"] == 1
+    assert art["p50_met"] == 1 and art["p99_met"] == 0
+    for key in ("p50_ms", "p99_ms", "target_p50_ms", "target_p99_ms",
+                "slo_target", "error_budget_remaining", "step"):
+        assert key in art
+
+
+def test_slo_percentiles_nearest_rank():
+    slo = SloTracker()
+    for ms in range(1, 101):
+        slo.record("t", "ok", latency_s=ms / 1e3)
+    assert slo.percentile(0.50) == pytest.approx(50.0)
+    assert slo.percentile(0.99) == pytest.approx(99.0)
+    assert slo.percentile(1.00) == pytest.approx(100.0)
+
+
+def test_slo_unknown_outcome_rejected():
+    with pytest.raises(ValueError):
+        SloTracker().record("t", "dropped")
+
+
+# ------------------------------------------------------- WAL group commit
+def _fill(journal, n=10):
+    for i in range(n):
+        journal.append(i, "tell", np.asarray([i], np.int32),
+                       np.asarray([[float(i)] * 4], np.float32),
+                       np.asarray([0], np.int32))
+
+
+def test_tell_journal_fsync_every_n_bit_identical(tmp_path):
+    from akka_tpu.persistence.tell_journal import TellJournal
+    a = TellJournal(str(tmp_path / "a.wal"), fsync_every_n=1)
+    b = TellJournal(str(tmp_path / "b.wal"), fsync_every_n=8)
+    _fill(a), _fill(b)
+    a.close(), b.close()
+    assert (tmp_path / "a.wal").read_bytes() == \
+        (tmp_path / "b.wal").read_bytes()
+
+
+def test_tell_journal_group_commit_crash_at_batch_boundary(tmp_path):
+    """kill -9 inside a group-commit window: every flushed record before
+    the torn tail survives; the torn record is truncated away on reopen
+    (repair_record_log), exactly as with per-record fsync."""
+    from akka_tpu.persistence.tell_journal import TellJournal
+    path = str(tmp_path / "j.wal")
+    j = TellJournal(path, fsync_every_n=8)
+    _fill(j, 10)
+    assert j._since_fsync == 2  # mid-window: 2 records past the last fsync
+    j._fh.flush()
+    # simulate the crash mid-append: tear the last record's tail
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size - 3)
+    j._fh.close()  # drop the writer without close() (no final fsync)
+
+    j2 = TellJournal(path, fsync_every_n=8)
+    recs = list(j2.records())
+    assert len(recs) == 9  # 9 intact, the torn 10th truncated
+    assert [int(r["step"]) for r in recs] == list(range(9))
+    assert j2.truncated_bytes > 0
+    # the journal stays appendable after repair
+    j2.append(99, "tell", np.asarray([0], np.int32),
+              np.asarray([[1.0] * 4], np.float32), np.asarray([0], np.int32))
+    j2.sync()
+    assert [int(r["step"]) for r in j2.records()][-1] == 99
+    j2.close()
+
+
+def test_tell_journal_sync_and_close_flush_pending(tmp_path):
+    from akka_tpu.persistence.tell_journal import TellJournal
+    j = TellJournal(str(tmp_path / "j.wal"), fsync_every_n=100)
+    _fill(j, 3)
+    assert j._since_fsync == 3
+    j.sync()
+    assert j._since_fsync == 0
+    _fill(j, 2)
+    j.close()  # close fsyncs the pending window
+    j2 = TellJournal(str(tmp_path / "j.wal"))
+    assert len(list(j2.records())) == 5
+    j2.close()
+
+
+# -------------------------------------------- typed ask-pool fast-fail
+BRIDGE_CFG = {"akka": {"stdout-loglevel": "OFF", "log-dead-letters": 0,
+                       "persistence": {"tell-journal": {"fsync-every-n": 4}},
+                       "actor": {"tpu-dispatcher": {
+                           "capacity": 256, "payload-width": 4,
+                           "mailbox-slots": 4, "promise-rows": 1}}}}
+
+
+def test_bridge_promise_rows_config_wiring():
+    """promise-rows and the WAL group-commit key flow through the
+    tpu-batched dispatcher config to the runtime handle (no device
+    build needed — the handle carries the knobs before first spawn)."""
+    from akka_tpu.batched.bridge import get_handle
+
+    system = ActorSystem.create("gw-cfgwire", BRIDGE_CFG)
+    try:
+        h = get_handle(system)
+        assert h.wal_fsync_every_n == 4
+        assert h.promise_rows_n == 1
+    finally:
+        system.terminate()
+        system.await_termination(10.0)
+
+
+@pytest.mark.slow
+def test_bridge_promise_rows_typed_exhaustion():
+    """Draining the pool fast-fails with AskPoolExhausted (typed — the
+    shed signal), not a timeout. Slow tier: spawning the device actor
+    compiles the bridge runtime (~13s); the tier-1 region-level twin
+    (test_region_typed_exhaustion_and_stats) keeps the typed error
+    covered cheaply."""
+    import jax.numpy as jnp
+    from akka_tpu.batched import Emit, Mailbox, behavior, device_props
+    from akka_tpu.batched.bridge import get_handle
+
+    @behavior("silent", {"count": ((), jnp.float32)}, inbox="slots")
+    def silent(state, mailbox: Mailbox, ctx):  # never replies
+        got = mailbox.fold(jnp.asarray(0.0, jnp.float32),
+                           lambda c, t, pl: c + pl[0])
+        return ({"count": state["count"] + got}, Emit.none(1, 4))
+
+    system = ActorSystem.create("gw-exhaust", BRIDGE_CFG)
+    try:
+        ref = system.actor_of(device_props(silent), "s1")
+        h = get_handle(system)
+        # satellite wiring: the system-wide WAL group-commit key reached
+        # the handle through the dispatcher
+        assert h.wal_fsync_every_n == 4
+        assert h.promise_rows_n == 1
+        f1 = h.ask(ref.row, (0, [1.0]), timeout=30.0)  # claims the only row
+        f2 = h.ask(ref.row, (0, [1.0]), timeout=30.0)  # pool empty: typed
+        with pytest.raises(AskPoolExhausted, match="promise rows exhausted"):
+            f2.result(5.0)
+        assert not f1.done()  # the in-flight ask is untouched
+        st = h.ask_pool_stats()
+        assert st["size"] == 1 and st["free"] == 0
+        assert st["exhausted"] == 1 and st["occupancy"] == 1.0
+    finally:
+        system.terminate()
+        system.await_termination(10.0)
+
+
+def test_region_typed_exhaustion_and_stats():
+    from akka_tpu.sharding.device import DeviceEntity, DeviceShardRegion
+    spec = DeviceEntity("exh", counter_behavior(4), n_shards=2,
+                        entities_per_shard=4, n_devices=1, payload_width=4)
+    region = DeviceShardRegion(spec)
+    region._ensure_promise_rows()
+    with region._lock:
+        parked, region._promise_free = region._promise_free, []
+    try:
+        with pytest.raises(AskPoolExhausted, match="promise rows exhausted"):
+            region.ask(0, 0, [1.0])
+        st = region.ask_pool_stats()
+        assert st["free"] == 0 and st["occupancy"] == 1.0
+        assert st["exhausted"] == 1
+    finally:
+        with region._lock:
+            region._promise_free = parked
+
+
+# ------------------------------------------------------ in-proc gateway
+def _req(server, tenant, entity, op, value=0.0, rid=1):
+    body = encode_body({"id": rid, "tenant": tenant, "entity": entity,
+                        "op": op, "value": value})
+    return json.loads(server.handle_frame(body))
+
+
+@pytest.fixture(scope="module")
+def small_region():
+    from akka_tpu.sharding.device import DeviceEntity, DeviceShardRegion
+    spec = DeviceEntity("gwc", counter_behavior(4), n_shards=2,
+                        entities_per_shard=8, n_devices=2, payload_width=4)
+    return DeviceShardRegion(spec)
+
+
+def test_gateway_inproc_smoke_below_threshold(small_region):
+    """Below the rate threshold: requests flow, rejects ~ 0, totals exact."""
+    slo = SloTracker()
+    adm = AdmissionController(rate=1e6, burst=1e6)
+    srv = GatewayServer(None, RegionBackend(small_region), adm, slo)
+    base = RegionBackend(small_region).sum_all()
+    total = 0.0
+    for i in range(12):
+        v = float(i % 3 + 1)
+        total += v
+        rep = _req(srv, "t0", f"acct-{i % 4}", "add", v, rid=i)
+        assert rep["status"] == "ok", rep
+    assert _req(srv, "t0", "acct-0", "get")["value"] == \
+        pytest.approx(1 + 2 + 3)  # i = 0, 4, 8 -> values 1, 2, 3
+    assert RegionBackend(small_region).sum_all() == \
+        pytest.approx(base + total)
+    art = slo.artifact()
+    assert art["rejects"] == 0 and art["ok"] == 13
+    assert art["p50_ms"] > 0
+
+
+def test_gateway_inproc_sheds_at_overload(small_region):
+    """Above the rate threshold the admission layer SHEDS (typed reject
+    replies with retry_after), it does not let requests pile into
+    timeouts."""
+    slo = SloTracker()
+    adm = AdmissionController(rate=1.0, burst=3.0)
+    srv = GatewayServer(None, RegionBackend(small_region), adm, slo)
+    statuses = [_req(srv, "t0", "acct-x", "add", 1.0, rid=i)["status"]
+                for i in range(10)]
+    assert statuses.count("ok") >= 3
+    sheds = [s for s in statuses if s == "shed"]
+    assert sheds, statuses
+    rep = _req(srv, "t0", "acct-x", "add", 1.0, rid=99)
+    assert rep["status"] == "shed" and rep["reason"] == "rate_limited"
+    assert rep["retry_after_ms"] > 0
+    art = slo.artifact()
+    assert art["rejects"] == len(sheds) + 1
+    assert art["reject_rate"] > 0
+    # rejects spent no error budget
+    assert art["error_budget_spent"] == 0
+
+
+def test_gateway_inproc_admin_and_errors(small_region):
+    slo = SloTracker()
+    srv = GatewayServer(None, RegionBackend(small_region),
+                        AdmissionController(rate=1e6, burst=1e6), slo)
+    assert _req(srv, "__admin", "", "sum")["status"] == "ok"
+    st = _req(srv, "__admin", "", "stats")["data"]
+    assert "admission" in st and "region" in st and "ask_pool" in st
+    art = _req(srv, "__admin", "", "artifact")["data"]
+    assert "p99_ms" in art and "reject_rate" in art
+    # typed errors, not dropped connections
+    assert _req(srv, "t0", "e", "frobnicate")["reason"] == \
+        "unknown_op:frobnicate"
+    bad = json.loads(srv.handle_frame(b"{not json"))
+    assert bad["status"] == "error" and \
+        bad["reason"].startswith("bad_request:")
+    assert _req(srv, "__admin", "", "nope")["reason"] == \
+        "unknown_admin_op:nope"
+
+
+def test_gateway_ask_pool_exhaustion_becomes_shed(small_region):
+    """The typed AskPoolExhausted fast-fail surfaces as a shed reply AND
+    arms the admission cooldown (subsequent requests shed without touching
+    the backend)."""
+    class ExhaustedBackend:
+        def ask(self, entity_id, value):
+            raise AskPoolExhausted("promise rows exhausted (test)")
+
+    clk = FakeClock()
+    adm = AdmissionController(rate=1e6, burst=1e6, cooldown_s=5.0, clock=clk)
+    srv = GatewayServer(None, ExhaustedBackend(), adm, SloTracker())
+    rep = _req(srv, "t0", "acct", "add", 1.0)
+    assert rep["status"] == "shed" and rep["reason"] == "ask_pool_exhausted"
+    rep2 = _req(srv, "t0", "acct", "add", 1.0, rid=2)
+    assert rep2["status"] == "shed"
+    assert rep2["reason"] == "overloaded:ask_pool_exhausted"
+
+
+# ------------------------------------------------------------ TCP ingress
+def _mk_system(name):
+    return ActorSystem(name, {"akka": {"stdout-loglevel": "OFF",
+                                       "log-dead-letters": 0}})
+
+
+def test_gateway_tcp_roundtrip(small_region):
+    system = _mk_system("gw-tcp")
+    try:
+        srv = GatewayServer(system, RegionBackend(small_region),
+                            AdmissionController(rate=1e6, burst=1e6),
+                            SloTracker())
+        host, port = srv.start()
+        client = GatewayClient(host, port)
+        try:
+            base = float(client.admin("sum")["value"])
+            assert client.request("t9", "tcp-acct", "add", 2.5)["status"] \
+                == "ok"
+            rep = client.request("t9", "tcp-acct", "add", 1.5)
+            assert rep["status"] == "ok" and rep["value"] == \
+                pytest.approx(4.0)
+            assert client.request("t9", "tcp-acct", "get")["value"] == \
+                pytest.approx(4.0)
+            assert float(client.admin("sum")["value"]) == \
+                pytest.approx(base + 4.0)
+        finally:
+            client.close()
+            srv.stop()
+    finally:
+        system.terminate()
+        system.await_termination(10.0)
+
+
+def test_gateway_slow_consumer_backpressure():
+    """Satellite: a stalled TCP consumer throttles the producer through
+    the ack-gated write path — processing PLATEAUS below the request
+    count instead of buffering every reply — then resumes cleanly with
+    zero loss and intact ordering once the consumer drains."""
+    system = _mk_system("gw-bp")
+    N, OP = 240, "x" * 30000  # unknown op -> ~30KB echo reply, no backend
+    slo = SloTracker()
+    srv = GatewayServer(system, None,
+                        AdmissionController(rate=1e9, burst=1e9), slo,
+                        max_frame=1 << 16)
+    try:
+        host, port = srv.start()
+        # a tiny receive buffer (set BEFORE connect so the advertised
+        # window honors it) makes the stall visible fast: the server can
+        # park at most rcvbuf+sndbuf bytes in the kernel
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+        sock.settimeout(60.0)
+        sock.connect((host, port))
+        blob = b"".join(
+            encode_frame({"id": i, "tenant": "t", "entity": "e", "op": OP})
+            for i in range(N))
+        sender = threading.Thread(target=sock.sendall, args=(blob,),
+                                  daemon=True)
+        sender.start()
+
+        # stalled consumer: watch the server-side processed counter stop
+        def processed():
+            return slo.artifact()["requests"]
+
+        last, stable_since = -1, time.monotonic()
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            cur = processed()
+            if cur != last:
+                last, stable_since = cur, time.monotonic()
+            elif cur > 0 and time.monotonic() - stable_since > 1.0:
+                break  # plateaued: backpressure reached the producer
+            time.sleep(0.05)
+        plateau = processed()
+        assert 0 < plateau < N, \
+            f"no backpressure: {plateau}/{N} processed while stalled"
+
+        # resume: drain everything — no drops, order preserved
+        reader = FrameReader(max_frame=1 << 20)
+        got = []
+        sock.settimeout(60.0)
+        while len(got) < N:
+            data = sock.recv(65536)
+            assert data, f"connection died after {len(got)}/{N} replies"
+            got.extend(reader.feed(data))
+        sender.join(timeout=30.0)
+        assert not sender.is_alive()
+        assert [g["id"] for g in got] == list(range(N))
+        assert all(g["status"] == "error" and
+                   g["reason"].startswith("unknown_op:") for g in got)
+        assert processed() == N
+        sock.close()
+    finally:
+        srv.stop()
+        system.terminate()
+        system.await_termination(10.0)

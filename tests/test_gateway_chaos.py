@@ -1,0 +1,213 @@
+"""Serving-gateway chaos tier: REAL OS processes, real TCP, mid-run chaos.
+
+Topology: 3 worker processes form a cluster (membership over real
+sockets, the multi_process conductor for barriers). Node 0 supervises a
+gateway-server CHILD process (examples/serving_gateway.py serve — a
+DeviceShardRegion of counter entities with an armed WAL + checkpoint
+dir, behind admission + SLO tracking) and injects the chaos legs over
+the wire as the `__admin` tenant; nodes 1-2 are sustained-load clients
+reconnecting through the outages.
+
+Chaos legs, in order, all under load:
+  1. shard rebalance            (admin op -> region.rebalance)
+  2. kill -9 the gateway child  (restart with --restore: snapshot + WAL)
+  3. device failover 2 -> 1     (admin op -> region.failover)
+
+Invariant: with sent_sum = sum over every wire send attempt and
+acked_sum = sum over "ok" replies,
+
+    acked_sum <= final_total <= sent_sum
+
+i.e. ZERO lost acknowledged writes (the WAL guarantee) and nothing
+applied that was never sent (at-most-once per attempt). The run also
+emits the p50/p99 SLO artifact."""
+
+import pytest
+
+from akka_tpu.testkit.multi_process import spawn_nodes
+
+pytestmark = pytest.mark.slow
+
+_COMMON = r"""
+import json, os, signal, socket, subprocess, sys, tempfile, time
+import akka_tpu
+from akka_tpu import ActorSystem
+from akka_tpu.cluster import Cluster
+from akka_tpu.gateway import GatewayClient
+from akka_tpu.testkit.dilation import dilated, dilated_s
+from akka_tpu.testkit.multi_process import (node_barrier, node_index,
+                                            node_count, node_result)
+
+IDX = node_index()
+N = node_count()
+BASE_PORT = int(os.environ["AKKA_TPU_TEST_BASE_PORT"])
+GW_PORT = BASE_PORT + 37
+STOP_FILE = os.path.join(tempfile.gettempdir(),
+                         f"gw_chaos_stop_{BASE_PORT}")
+EXAMPLE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(akka_tpu.__file__))), "examples", "serving_gateway.py")
+
+def make_system(extra=None):
+    cfg = {"akka": {"actor": {"provider": "cluster"},
+                    "stdout-loglevel": "OFF", "log-dead-letters": 0,
+                    "remote": {"transport": "tcp",
+                               "canonical": {"hostname": "127.0.0.1",
+                                             "port": BASE_PORT + IDX}},
+                    "cluster": {"gossip-interval": "0.1s",
+                                "leader-actions-interval": "0.1s"}}}
+    if extra:
+        cfg["akka"].update(extra)
+    return ActorSystem(f"mp{IDX}", cfg)
+
+def up_count(system):
+    return len([m for m in Cluster.get(system).state.members
+                if m.status.value == "Up"])
+
+def await_(cond, secs, what):
+    deadline = time.monotonic() + dilated(secs)
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.1)
+    raise AssertionError("timeout waiting for " + what)
+
+def spawn_serve(directory, restore=False):
+    cmd = [sys.executable, EXAMPLE, "serve", "--port", str(GW_PORT),
+           "--dir", directory, "--devices", "2", "--shards", "4",
+           "--eps", "16", "--rate", "1000", "--burst", "500"]
+    if restore:
+        cmd.append("--restore")
+    p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+    deadline = time.monotonic() + dilated(120.0)
+    while time.monotonic() < deadline:
+        line = p.stdout.readline()
+        if not line:
+            raise AssertionError(f"serve child died rc={p.poll()}")
+        sys.stderr.write(f"[serve:{IDX}] {line}")
+        if line.startswith("READY "):
+            return p
+    raise AssertionError("serve child never printed READY")
+"""
+
+
+def test_gateway_survives_rebalance_crash_and_failover():
+    worker = _COMMON + r"""
+system = make_system()
+seed = f"akka://mp0@127.0.0.1:{BASE_PORT}"
+node_barrier("boot", timeout=dilated(120.0))
+Cluster.get(system).join(seed)
+await_(lambda: up_count(system) == 3, 60, "3 members Up")
+node_barrier("converged", timeout=dilated(120.0))
+
+if IDX == 0:
+    # ------------------------------------------ gateway supervisor + chaos
+    if os.path.exists(STOP_FILE):
+        os.remove(STOP_FILE)
+    gw_dir = tempfile.mkdtemp(prefix="gw_chaos_")
+    serve = spawn_serve(gw_dir)
+    node_barrier("gw_up", timeout=dilated(180.0))
+    admin = GatewayClient("127.0.0.1", GW_PORT, timeout=30.0)
+    legs = {}
+
+    time.sleep(dilated(2.0))  # load flowing against the initial placement
+    rep = admin.request_retry("__admin", "", "rebalance", 0.0,
+                              deadline_s=dilated(60.0))
+    legs["rebalance"] = rep["status"]
+
+    time.sleep(dilated(2.0))
+    serve.send_signal(signal.SIGKILL)   # chaos: the process, not the data
+    serve.wait()
+    admin.close()
+    serve = spawn_serve(gw_dir, restore=True)
+    legs["crash_restore"] = "ok"
+
+    time.sleep(dilated(2.0))
+    rep = admin.request_retry("__admin", "", "failover", 1.0,
+                              deadline_s=dilated(90.0))
+    legs["failover"] = rep["status"]
+
+    time.sleep(dilated(3.0))  # post-failover traffic on the survivor mesh
+    open(STOP_FILE, "w").close()
+    node_barrier("load_done", timeout=dilated(240.0))
+
+    # loads are quiesced: the conserved-value probe is stable now
+    final = admin.request_retry("__admin", "", "sum",
+                                deadline_s=dilated(60.0))
+    artifact = admin.request_retry("__admin", "", "artifact",
+                                   deadline_s=dilated(60.0))["data"]
+    admin.close()
+    serve.send_signal(signal.SIGTERM)
+    try:
+        serve.wait(timeout=dilated(30.0))
+    except subprocess.TimeoutExpired:
+        serve.kill()
+    os.remove(STOP_FILE)
+    node_result({"role": "chaos", "legs": legs,
+                 "final_total": float(final["value"]),
+                 "artifact": {k: v for k, v in artifact.items()
+                              if k != "per_tenant"}})
+else:
+    # ------------------------------------------------- sustained-load client
+    node_barrier("gw_up", timeout=dilated(180.0))
+    client = GatewayClient("127.0.0.1", GW_PORT, timeout=10.0)
+    sent_sum = acked_sum = 0.0
+    counts = {"ok": 0, "shed": 0, "error": 0, "conn_error": 0}
+    i = 0
+    while not os.path.exists(STOP_FILE):
+        i += 1
+        value = float(i % 5 + 1)
+        # one wire send attempt == one sent_sum credit: resends after a
+        # connection death count again, keeping final <= sent_sum valid
+        sent_sum += value
+        try:
+            rep = client.request(f"tenant{IDX}",
+                                 f"n{IDX}-acct-{i % 4}", "add", value)
+        except (OSError, ConnectionError, socket.timeout):
+            counts["conn_error"] += 1
+            client.close()
+            time.sleep(0.2)
+            continue
+        if rep.get("status") == "ok":
+            acked_sum += value
+            counts["ok"] += 1
+        elif rep.get("status") == "shed":
+            counts["shed"] += 1
+            time.sleep(min(1.0, rep.get("retry_after_ms", 100) / 1e3))
+        else:
+            counts["error"] += 1
+        time.sleep(0.01)
+    client.close()
+    node_barrier("load_done", timeout=dilated(240.0))
+    node_result({"role": "load", "sent_sum": sent_sum,
+                 "acked_sum": acked_sum, **counts})
+
+node_barrier("done", timeout=dilated(120.0))
+system.terminate(); system.await_termination(10)
+"""
+    results, _ = spawn_nodes(worker, 3, timeout=900.0,
+                             extra_env={"AKKA_TPU_TEST_BASE_PORT": "23710"})
+    chaos = results[0]
+    loads = [results[1], results[2]]
+    assert chaos["role"] == "chaos"
+    # every chaos leg executed through the front door
+    assert chaos["legs"] == {"rebalance": "ok", "crash_restore": "ok",
+                             "failover": "ok"}, chaos["legs"]
+
+    sent = sum(r["sent_sum"] for r in loads)
+    acked = sum(r["acked_sum"] for r in loads)
+    final = chaos["final_total"]
+    # clients actually exercised the gateway across the outages
+    assert all(r["ok"] > 0 for r in loads), loads
+    assert acked > 0
+    # THE conserved-value invariant: no acknowledged write lost (WAL +
+    # snapshot + replay), nothing conjured beyond what was sent
+    assert acked - 1e-6 <= final <= sent + 1e-6, \
+        f"acked={acked} final={final} sent={sent}"
+
+    # the SLO artifact came out of the run with the stable schema
+    art = chaos["artifact"]
+    for key in ("p50_ms", "p99_ms", "reject_rate", "requests",
+                "error_budget_remaining"):
+        assert key in art, art
+    assert art["requests"] > 0

@@ -341,10 +341,39 @@ def on_tpu_found(detail: str) -> None:
                         "survivors": fo.get("survivors"),
                         "device_evicted": ev.get("device_evicted"),
                         "failover_completed": ev.get("failover_completed")})
+    # serving gateway on-chip: sustained-load p50/p99 through the in-proc
+    # ingress (admission + SLO tracker on a real device region) plus the
+    # overload leg's reject rate — the SLO artifact row next to the other
+    # subsystem rows (docs/SERVING_GATEWAY.md schema)
+    run_logged("gateway", [sys.executable, "bench.py", "--config",
+                           "gateway-slo", "--probe-timeout", "120"],
+               timeout_s=1800)
+    gw_out = os.path.join(REPO, "watchdog_gateway.out")
+    if os.path.exists(gw_out):
+        gj = None
+        for line in open(gw_out):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    gj = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
+        gw = (gj or {}).get("extra", {}).get("gateway", {})
+        if gw:
+            below = gw.get("below_threshold", {})
+            over = gw.get("overload", {})
+            append_log({"ts": _utcnow(), "ok": bool(gw.get("shed_working")),
+                        "detail": "serving gateway SLO stats",
+                        "p50_ms": below.get("p50_ms"),
+                        "p99_ms": below.get("p99_ms"),
+                        "req_per_sec": below.get("req_per_sec"),
+                        "overload_reject_rate": over.get("reject_rate"),
+                        "shed_working": gw.get("shed_working")})
     paths = [LOG, "watchdog_bench_full.out", "watchdog_attrib.out",
              "watchdog_trace.out", "watchdog_supervision.out",
              "watchdog_bridge.out", "watchdog_checkpoint.out",
-             "watchdog_metrics.out", "watchdog_failover.out"]
+             "watchdog_metrics.out", "watchdog_failover.out",
+             "watchdog_gateway.out"]
     if last is not None:
         paths.append("BENCH_TPU.json")
     if os.path.isdir(os.path.join(REPO, "traces/tpu_r05")):
