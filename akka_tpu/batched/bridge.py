@@ -131,6 +131,24 @@ def max_exact_row_id(dtype) -> int:
     return 1 << (jnp.finfo(dt).nmant + 1)
 
 
+def read_promise_block(state, base: int, n: int, replied_col: str,
+                       reply_col: Optional[str] = None):
+    """One static-slice host fetch of a promise block's latch (and,
+    optionally, reply) columns: constant shape -> one XLA program ever —
+    a per-waiter-count gather would recompile for every distinct shape,
+    seconds per compile over a tunneled backend. Shared by the bridge's
+    `_resolve_waiters` drain, the region's batched ask engine
+    (sharding/ask_batch.py) and its retired-slot reclaim. Returns
+    `(replied, replies)` numpy arrays (`replies` is None when `reply_col`
+    is not requested); the device_get blocks until every enqueued step
+    has produced the newest state handle."""
+    replied = np.asarray(jax.device_get(state[replied_col][base:base + n]))
+    if reply_col is None:
+        return replied, None
+    replies = np.asarray(jax.device_get(state[reply_col][base:base + n]))
+    return replied, replies
+
+
 def _slice_init(value, idx_or_mask, n_rows: int):
     """Select the per-row slice of an init value: arrays whose leading dim
     matches the spawn's row count are per-row (spawn_block broadcast
@@ -668,15 +686,9 @@ class BatchedRuntimeHandle:
         base, np_ = self._promise_base, self.promise_rows_n
         with self._step_lock:  # state reads must not race donation
             rt = self._runtime  # re-resolve: rebuild swaps under lock
-            # fetch the WHOLE promise block with a static slice: constant
-            # shape -> one XLA program ever (a per-waiter-count gather would
-            # recompile for every distinct shape — seconds per compile over
-            # a tunneled backend)
-            import jax as _jax
-            replied_blk = np.asarray(_jax.device_get(
-                rt.state[self.PROMISE_REPLIED][base:base + np_]))
-            replies_blk = np.asarray(_jax.device_get(
-                rt.state[self.PROMISE_REPLY][base:base + np_]))
+            replied_blk, replies_blk = read_promise_block(
+                rt.state, base, np_, self.PROMISE_REPLIED,
+                self.PROMISE_REPLY)
         replied = [replied_blk[r - base] for r, _ in waiting]
         replies = [replies_blk[r - base] for r, _ in waiting]
         now = time.monotonic()

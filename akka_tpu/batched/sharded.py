@@ -525,6 +525,21 @@ class ShardedBatchedSystem:
             pls.append(p)
         if not idxs:
             return
+        # pad to the next power of two (floor 64) by repeating the first
+        # record: a duplicate scatter index carrying identical values is
+        # idempotent, and the padded shape bounds the compiled-scatter
+        # count — the floor means every flush up to 64 records shares ONE
+        # compiled program. Unpadded, .at[idx].set compiles a fresh
+        # program for EVERY distinct flush count — invisible when tells
+        # trickle in one per step, ruinous once the batched ask engine
+        # flushes whole batches whose sizes vary with concurrency.
+        n = len(idxs)
+        pad = max(64, 1 << (n - 1).bit_length()) - n
+        if pad:
+            idxs.extend(idxs[:1] * pad)
+            dsts.extend(dsts[:1] * pad)
+            mts.extend(mts[:1] * pad)
+            pls.extend(pls[:1] * pad)
         idx = jnp.asarray(idxs)
         self.inbox_dst = self.inbox_dst.at[idx].set(jnp.asarray(dsts, jnp.int32))
         self.inbox_type = self.inbox_type.at[idx].set(jnp.asarray(mts, jnp.int32))

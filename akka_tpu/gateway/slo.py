@@ -60,11 +60,21 @@ class SloTracker:
         self._lat_seq = 0
         self._lat_sorted = (-1, [])
         self._hist = None
+        self._batcher = None
         if registry is not None:
             registry.register_collector("gateway", self._collect)
             self._hist = registry.histogram(
                 "gateway_ask_latency_ms",
                 "gateway request latency (admitted asks), milliseconds")
+
+    def attach_batcher(self, batcher) -> None:
+        """Carry the ask-batching summary (AskBatcher.stats: batches,
+        asks, mean_batch_size, ...) in artifact() as `ask_batch`, so the
+        bench rows, the watchdog row and the example's slo.json all show
+        how much coalescing the traffic actually got. The size/window
+        histograms live on the MetricsRegistry; this is the stable-schema
+        summary next to the latency numbers it explains."""
+        self._batcher = batcher
 
     # -------------------------------------------------------------- record
     def record(self, tenant: str, outcome: str,
@@ -107,7 +117,10 @@ class SloTracker:
         budget_total = (1.0 - self.slo_target) * served
         p50, p99 = self.percentile(0.50), self.percentile(0.99)
         step = self.registry.step if self.registry is not None else 0
+        batch = ({"ask_batch": self._batcher.stats()}
+                 if self._batcher is not None else {})
         return {
+            **batch,
             "requests": total,
             "ok": counts["ok"],
             "rejects": counts["reject"],

@@ -22,6 +22,7 @@ from .typed import (ClusterShardingTyped, Entity, EntityContext, EntityRef,
                     EntityTypeKey)
 from .daemon_process import (ShardedDaemonProcess,
                              ShardedDaemonProcessSettings)
+from .ask_batch import AskBatcher
 
 __all__ = [
     "ShardingEnvelope", "StartEntity", "StartEntityAck", "Passivate",
@@ -35,4 +36,5 @@ __all__ = [
     "ClusterShardingTyped", "Entity", "EntityContext", "EntityRef",
     "EntityTypeKey",
     "ShardedDaemonProcess", "ShardedDaemonProcessSettings",
+    "AskBatcher",
 ]

@@ -1,0 +1,361 @@
+"""Ask micro-batching: coalesce concurrent region asks into shared step
+rounds (ISSUE 9 tentpole).
+
+PR 8's gateway routed every request through `DeviceShardRegion.ask`,
+which holds `_ask_lock` for the whole stage→step→poll round — N
+concurrent clients paid N full device rounds even though the promise-row
+pool was built for many in-flight asks. This module is the dispatcher
+`throughput` idea (many mailbox messages per thread acquisition) applied
+to the ask path: collect asks that arrive within an adaptive window,
+allocate each its promise row, stage ALL the tells as one coalesced
+flush, run ONE shared step budget, and resolve every latch from one
+static-slice read of the promise block.
+
+Two layers:
+
+- `execute_ask_batch(region, batch)`: the synchronous engine. Caller
+  holds `region._ask_lock`; per-ask timeout/retirement semantics are
+  byte-for-byte those of the old `ask` (a batch of one runs the exact
+  same step schedule, so solo results are bit-identical).
+- `AskBatcher`: the thread-safe futures front end the gateway uses.
+  `submit()` returns a Future; a lazily-started daemon dispatcher thread
+  closes batches (N pending or T µs, whichever first) and runs them
+  under the ask lock. `handle_frame` stays synchronous per connection —
+  batching emerges from concurrent connections.
+
+One scheduling rule is load-bearing: the dense-inbox reduce SUMS
+payloads, so two asks addressed to the SAME entity row in one step round
+would sum their reply-row columns and misroute both replies. The engine
+therefore stages at most one in-flight ask per destination row per wave;
+duplicates wait for the current occupant to resolve and ride a later
+wave — which is also what gives per-entity linearized totals.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["BatchAsk", "execute_ask_batch", "AskBatcher"]
+
+
+class BatchAsk:
+    """One ask riding a batch: request in, outcome (reply payload or the
+    per-ask exception instance) out."""
+
+    __slots__ = ("shard", "index", "message", "steps", "max_extra_steps",
+                 "slot", "prow", "row", "start", "outcome", "future",
+                 "t_submit")
+
+    def __init__(self, shard: int, index: int, message: Any,
+                 steps: int = 2, max_extra_steps: int = 8):
+        self.shard = shard
+        self.index = index
+        self.message = message
+        self.steps = steps
+        self.max_extra_steps = max_extra_steps
+        self.slot: Optional[int] = None
+        self.prow: Optional[int] = None
+        self.row: Optional[int] = None
+        self.start = 0
+        self.outcome: Any = None
+        self.future: Optional[Future] = None
+        self.t_submit = 0.0
+
+
+def _reset_batch_latches(region, slots: Sequence[int]) -> None:
+    """Lower `__promise_replied` for the batch's slots before reuse: ONE
+    static-shape masked update over the whole promise block (the bridge
+    `_clear_latches` idiom — a per-slot-count scatter would recompile for
+    every distinct batch size). Slots NOT in the batch — live asks from a
+    previous wave, retired timeouts waiting for their late reply — are
+    deliberately untouched."""
+    sys = region.system
+    eps = region.eps
+    base = region._promise_block * eps
+    mask = np.zeros((eps,), np.bool_)
+    mask[np.asarray(list(slots), np.int64)] = True
+    col = sys.state["__promise_replied"]
+    blk = jnp.where(jnp.asarray(mask), False, col[base:base + eps])
+    sys.state["__promise_replied"] = col.at[base:base + eps].set(blk)
+
+
+def execute_ask_batch(region, batch: Sequence[BatchAsk]) -> None:
+    """Run a batch of asks through shared step rounds. Caller holds
+    `region._ask_lock`. Fills each member's `.outcome` with the reply
+    payload (np.ndarray) or an exception instance (AskPoolExhausted /
+    ValueError / TimeoutError) — never raises for per-ask conditions, so
+    one member's timeout cannot fail its batch-mates."""
+    from ..batched.bridge import AskPoolExhausted, max_exact_row_id
+    from ..batched.supervision import decode_attention
+
+    region._ensure_promise_rows()
+    region._reclaim_promise_slots()  # once per BATCH, not once per ask
+    sys = region.system
+    eps = region.eps
+    base = region._promise_block * eps
+    limit = max_exact_row_id(sys.payload_dtype)
+
+    # -- assembly: one promise slot per member; pool overflow is a typed
+    # per-member fast-fail (the admission layer sheds on it), not a batch
+    # failure
+    live: List[BatchAsk] = []
+    for a in batch:
+        with region._lock:
+            if not region._promise_free:
+                region._stat_ask_exhausted += 1
+                a.outcome = AskPoolExhausted(
+                    f"promise rows exhausted ({eps} slots, "
+                    f"{len(region._promise_retired)} retired)")
+                continue
+            a.slot = region._promise_free.pop()
+        prow = base + a.slot
+        if prow > limit:
+            with region._lock:
+                region._promise_free.append(a.slot)
+            a.slot = None
+            a.outcome = ValueError(
+                f"promise row {prow} not exactly representable in "
+                f"{jnp.dtype(sys.payload_dtype).name} payloads")
+            continue
+        a.prow = prow
+        a.row = region.row_of(a.shard, a.index)
+        live.append(a)
+    if not live:
+        return
+
+    _reset_batch_latches(region, [a.slot for a in live])
+
+    # -- wave scheduling: at most ONE in-flight ask per destination row
+    # (see module docstring); each wave's tells coalesce into the next
+    # run's single flush
+    waiting = list(live)
+    in_flight = {}  # row -> BatchAsk
+    cum = 0  # steps run so far in this batch
+
+    def stage_ready() -> None:
+        nonlocal waiting
+        rest: List[BatchAsk] = []
+        for a in waiting:
+            if a.row in in_flight:
+                rest.append(a)
+                continue
+            payload = np.zeros((sys.payload_width,), np.float32)
+            body = np.atleast_1d(
+                np.asarray(a.message, np.float32)).reshape(-1)
+            payload[:min(len(body), sys.payload_width - 1)] = \
+                body[:sys.payload_width - 1]
+            payload[-1] = float(a.prow)
+            sys.tell(a.row, payload)
+            a.start = cum
+            in_flight[a.row] = a
+        waiting = rest
+
+    stage_ready()
+    first = True
+    while in_flight:
+        # shared budget: one `steps`-deep round for the whole wave, then
+        # single steps — a batch of one runs the exact schedule the
+        # pre-batching ask() ran ([steps] + [1]*max_extra_steps)
+        n_steps = min(a.steps for a in in_flight.values()) if first else 1
+        first = False
+        sys.run(n_steps)
+        cum += n_steps
+        # "all replied?" rides the attention word: the tiny device_get
+        # doubles as the run's sync (bridge _drain_one idiom), and the
+        # wide promise-block readback is paid only when ATT_LATCH_BIT
+        # says some latch is actually high
+        att = decode_attention(sys.attention)
+        replied_blk = reply_blk = None
+        if att["any_latched"] or not getattr(region, "_ask_latch_wired",
+                                             False):
+            from ..batched.bridge import read_promise_block
+            replied_blk, reply_blk = read_promise_block(
+                sys.state, base, eps, "__promise_replied",
+                "__promise_reply")
+        done_rows: List[int] = []
+        for row, a in in_flight.items():
+            if replied_blk is not None and bool(replied_blk[a.slot]):
+                a.outcome = np.asarray(reply_blk[a.slot])
+                with region._lock:
+                    region._promise_free.append(a.slot)
+                done_rows.append(row)
+            elif cum - a.start >= a.steps + a.max_extra_steps:
+                # timed out: RETIRE the slot (late replies must land in a
+                # row no future ask will read); _reclaim_promise_slots
+                # returns it once the straggler's latch shows up
+                with region._lock:
+                    region._promise_retired.append(a.slot)
+                a.outcome = TimeoutError(
+                    f"ask to shard {a.shard} index {a.index} unanswered "
+                    f"after {a.steps + a.max_extra_steps} steps")
+                done_rows.append(row)
+        for row in done_rows:
+            del in_flight[row]
+        stage_ready()  # duplicates deferred from earlier waves
+
+
+class AskBatcher:
+    """Thread-safe futures front end over `execute_ask_batch`.
+
+    `submit()` appends to the pending list and returns a Future; a
+    daemon dispatcher thread (started on first submit, the bridge pump
+    idiom) closes a batch when `max_batch` asks are pending or
+    `window_s` has elapsed since it saw the first one — whichever first
+    — and runs it under the region's ask lock. Callers never become
+    batch leaders, so no connection handler gets stuck dispatching other
+    tenants' traffic under sustained load.
+
+    With a MetricsRegistry: `gateway_ask_batch_size` and
+    `gateway_ask_batch_window_us` histograms, plus an "ask_batch"
+    collector exposing the summary counters."""
+
+    def __init__(self, region, max_batch: int = 32,
+                 window_s: float = 200e-6, steps: int = 2,
+                 max_extra_steps: int = 8, registry=None):
+        self.region = region
+        # a batch larger than the promise pool would guarantee typed
+        # exhaustion for the overflow members; cap it at the pool size
+        pool = int(getattr(region, "eps", max_batch))
+        self.max_batch = max(1, min(int(max_batch), pool))
+        self.window_s = float(window_s)
+        self.steps = int(steps)
+        self.max_extra_steps = int(max_extra_steps)
+        self._lock = threading.Lock()
+        self._work = threading.Event()
+        self._pending: List[BatchAsk] = []
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._batches = 0
+        self._asks = 0
+        self._multi = 0
+        self._max_seen = 0
+        self._h_size = self._h_wait = None
+        if registry is not None:
+            self._h_size = registry.histogram(
+                "gateway_ask_batch_size",
+                "asks coalesced per shared device step round")
+            self._h_wait = registry.histogram(
+                "gateway_ask_batch_window_us",
+                "microseconds an ask waited for its batch to close")
+            registry.register_collector("ask_batch", self.stats)
+
+    # ------------------------------------------------------------- submit
+    def submit(self, shard: int, index: int, message: Any,
+               steps: Optional[int] = None,
+               max_extra_steps: Optional[int] = None) -> Future:
+        a = BatchAsk(int(shard), int(index), message,
+                     self.steps if steps is None else int(steps),
+                     self.max_extra_steps if max_extra_steps is None
+                     else int(max_extra_steps))
+        a.future = Future()
+        a.t_submit = time.perf_counter()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("AskBatcher is closed")
+            self._pending.append(a)
+            if self._thread is None:
+                t = threading.Thread(target=self._loop,
+                                     name="akka-tpu-ask-batcher",
+                                     daemon=True)
+                self._thread = t
+                t.start()
+        self._work.set()
+        return a.future
+
+    def ask(self, shard: int, index: int, message: Any,
+            steps: Optional[int] = None,
+            max_extra_steps: Optional[int] = None):
+        """Submit and wait: returns the reply payload or raises the
+        per-ask exception (TimeoutError / AskPoolExhausted / ...)."""
+        return self.submit(shard, index, message, steps,
+                           max_extra_steps).result()
+
+    # ---------------------------------------------------------- dispatcher
+    def _loop(self) -> None:
+        while True:
+            self._work.wait(0.25)
+            self._work.clear()
+            if self._closed:
+                self._fail_pending(RuntimeError("AskBatcher is closed"))
+                return
+            while True:
+                with self._lock:
+                    if not self._pending:
+                        break
+                # adaptive window: wait for the batch to fill, close on
+                # max_batch pending or window_s elapsed, whichever first
+                deadline = time.perf_counter() + self.window_s
+                while True:
+                    with self._lock:
+                        if len(self._pending) >= self.max_batch:
+                            break
+                    remain = deadline - time.perf_counter()
+                    if remain <= 0:
+                        break
+                    self._work.wait(remain)
+                    self._work.clear()
+                with self._lock:
+                    close_batch = self._pending[:self.max_batch]
+                    del self._pending[:self.max_batch]
+                if close_batch:
+                    self._run_batch(close_batch)
+
+    def _run_batch(self, close_batch: List[BatchAsk]) -> None:
+        t_close = time.perf_counter()
+        region = self.region
+        try:
+            with region._ask_lock:
+                execute_ask_batch(region, close_batch)
+        except BaseException as e:  # noqa: BLE001 — waiters must never hang
+            for a in close_batch:
+                if a.outcome is None:
+                    a.outcome = e
+        with self._lock:
+            self._batches += 1
+            self._asks += len(close_batch)
+            self._max_seen = max(self._max_seen, len(close_batch))
+            if len(close_batch) > 1:
+                self._multi += 1
+        if self._h_size is not None:
+            self._h_size.observe(float(len(close_batch)))
+        for a in close_batch:
+            if self._h_wait is not None:
+                self._h_wait.observe((t_close - a.t_submit) * 1e6)
+            if isinstance(a.outcome, BaseException):
+                a.future.set_exception(a.outcome)
+            else:
+                a.future.set_result(a.outcome)
+
+    # ------------------------------------------------------------ lifecycle
+    def _fail_pending(self, exc: BaseException) -> None:
+        with self._lock:
+            pending, self._pending = self._pending, []
+        for a in pending:
+            if a.future is not None and not a.future.done():
+                a.future.set_exception(exc)
+
+    def close(self, timeout: float = 10.0) -> None:
+        with self._lock:
+            self._closed = True
+            t = self._thread
+        self._work.set()
+        if t is not None:
+            t.join(timeout)
+        self._fail_pending(RuntimeError("AskBatcher is closed"))
+
+    # ---------------------------------------------------------------- stats
+    def stats(self):
+        """Numeric summary (registry-collector compatible)."""
+        with self._lock:
+            b, n = self._batches, self._asks
+            return {"batches": float(b), "asks": float(n),
+                    "mean_batch_size": (n / b) if b else 0.0,
+                    "max_batch_size": float(self._max_seen),
+                    "multi_ask_batches": float(self._multi),
+                    "pending": float(len(self._pending))}

@@ -59,6 +59,10 @@ class DeviceEntity:
     mailbox_slots: int = 0
     host_inbox_per_shard: int = 256
     extra_behaviors: Sequence[BatchedBehavior] = field(default_factory=tuple)
+    # forwarded to ShardedBatchedSystem: pin the delivery kernel family
+    # (None = auto). The batched-ask tests pin both backends to prove the
+    # conserved-value invariant is bit-identical across them.
+    delivery_backend: Optional[str] = None
     # optional coordination lease (cluster_tools.lease.Lease): when set,
     # rebalance() must ACQUIRE it first — the reference guards shard
     # hand-off with a lease so two coordinators can't move shards
@@ -133,7 +137,13 @@ class DeviceShardRegion:
             payload_width=spec.payload_width, out_degree=spec.out_degree,
             host_inbox_per_shard=spec.host_inbox_per_shard,
             mailbox_slots=spec.mailbox_slots,
-            reroute_strays=True)  # messages follow rebalanced shards
+            reroute_strays=True,  # messages follow rebalanced shards
+            delivery_backend=spec.delivery_backend,
+            # raise ATT_LATCH_BIT while any promise latch is high: the
+            # batched ask engine polls "anyone replied?" off the tiny
+            # attention word instead of a wide per-round state read
+            attention_latch_col="__promise_replied")
+        self._ask_latch_wired = True
 
         # initial allocation: shard s -> block s striped over devices
         # round-robin (LeastShardAllocation on an empty cluster assigns
@@ -230,80 +240,60 @@ class DeviceShardRegion:
 
         Runs `steps` steps (request out + reply back), then single steps up
         to `max_extra_steps` more before declaring the ask unanswered.
-        Asks SERIALIZE (this is a stepping API driving the shared runtime);
-        a timed-out ask's slot is retired, not reused — a late reply
+        A timed-out ask's slot is retired, not reused — a late reply
         landing in a recycled row would otherwise answer the wrong ask.
         Retirement is not permanent: once the late reply is observed to
-        have landed (`__promise_replied` True) the slot is reclaimed."""
-        from ..batched.bridge import max_exact_row_id
+        have landed (`__promise_replied` True) the slot is reclaimed.
+
+        Implemented as a batch of one through the ask micro-batching
+        engine (ask_batch.py) — a solo batch runs the exact step schedule
+        this method always ran, so results are bit-identical."""
+        out = self.ask_many([(shard, index, message)], steps=steps,
+                            max_extra_steps=max_extra_steps)[0]
+        if isinstance(out, BaseException):
+            raise out
+        return out
+
+    def ask_many(self, requests: Sequence[Any], steps: int = 2,
+                 max_extra_steps: int = 8) -> List[Any]:
+        """Coalesced asks: `requests` is a sequence of
+        `(shard, index, message)`; every member gets its own promise row,
+        all the tells go out in ONE flush, and the whole batch shares one
+        step budget instead of paying N serialized device rounds
+        (gateway concurrency rides this via AskBatcher).
+
+        Returns a list aligned with `requests`: the reply payload
+        (np.ndarray), or the per-ask exception INSTANCE (AskPoolExhausted
+        / TimeoutError / ValueError) — one member's failure never fails
+        its batch-mates. Per-ask timeout/retirement semantics match
+        `ask` exactly; asks to the SAME entity serialize across waves
+        within the batch (linearized per-entity totals)."""
+        from .ask_batch import BatchAsk, execute_ask_batch
+        batch = [BatchAsk(int(s), int(i), m, int(steps),
+                          int(max_extra_steps)) for s, i, m in requests]
         with self._ask_lock:
-            self._ensure_promise_rows()
-            self._reclaim_promise_slots()
-            sys = self.system
-            with self._lock:
-                if not self._promise_free:
-                    from ..batched.bridge import AskPoolExhausted
-                    self._stat_ask_exhausted += 1
-                    raise AskPoolExhausted(
-                        f"promise rows exhausted ({self.eps} slots, "
-                        f"{len(self._promise_retired)} retired)")
-                slot = self._promise_free.pop()
-            prow = self._promise_block * self.eps + slot
-            if prow > max_exact_row_id(sys.payload_dtype):
-                with self._lock:
-                    self._promise_free.append(slot)
-                raise ValueError(
-                    f"promise row {prow} not exactly representable in "
-                    f"{jnp.dtype(sys.payload_dtype).name} payloads")
-            sys.state["__promise_replied"] = \
-                sys.state["__promise_replied"].at[prow].set(False)
-            payload = np.zeros((sys.payload_width,), np.float32)
-            body = np.atleast_1d(np.asarray(message, np.float32)).reshape(-1)
-            payload[:min(len(body), sys.payload_width - 1)] = \
-                body[:sys.payload_width - 1]
-            payload[-1] = float(prow)
-            sys.tell(self.row_of(shard, index), payload)
-
-            def replied() -> bool:
-                return bool(sys.read_state(
-                    "__promise_replied",
-                    np.asarray([prow], np.int32))[0])
-
-            budgets = [steps] + [1] * max_extra_steps
-            for n_steps in budgets:
-                sys.run(n_steps)
-                sys.block_until_ready()
-                if replied():
-                    with self._lock:
-                        self._promise_free.append(slot)
-                    return np.asarray(sys.read_state(
-                        "__promise_reply",
-                        np.asarray([prow], np.int32))[0])
-            # timed out: RETIRE the slot (late replies must land in a row
-            # no future ask will read — the bridge's promise-zombie rule).
-            # It is parked, not leaked: _reclaim_promise_slots returns it
-            # once the latch shows the straggler reply arrived.
-            with self._lock:
-                self._promise_retired.append(slot)
-            raise TimeoutError(
-                f"ask to shard {shard} index {index} unanswered after "
-                f"{steps + max_extra_steps} steps")
+            execute_ask_batch(self, batch)
+        return [a.outcome for a in batch]
 
     def _reclaim_promise_slots(self) -> int:
         """Return retired ask slots whose `__promise_replied` latch is now
         True to the free list. A True latch means the late reply HAS landed,
         so no in-flight message can target the row any more and recycling
         cannot mis-deliver (every ask resets the latch before use). Called
-        on each ask; safe to call directly. Returns the number reclaimed."""
+        once per ask BATCH; safe to call directly. Returns the number
+        reclaimed."""
         with self._lock:
             retired = list(self._promise_retired)
         if not retired:
             return 0
+        # one static-slice fetch of the whole promise block's latch column
+        # (read_promise_block: constant shape -> one XLA program ever; the
+        # old per-retired-count gather recompiled for every distinct count)
+        from ..batched.bridge import read_promise_block
         base = self._promise_block * self.eps
-        rows = np.asarray([base + s for s in retired], np.int32)
-        landed = np.asarray(
-            self.system.read_state("__promise_replied", rows))
-        freed = [s for s, ok in zip(retired, landed) if bool(ok)]
+        landed, _ = read_promise_block(self.system.state, base, self.eps,
+                                       "__promise_replied")
+        freed = [s for s in retired if bool(landed[s])]
         with self._lock:
             for s in freed:
                 self._promise_retired.remove(s)
@@ -356,14 +346,16 @@ class DeviceShardRegion:
             n_new = idx + 1 - self._spawned[shard]
             start_idx = int(self._spawned[shard])
             self._spawned[shard] = idx + 1
-            # the array read-modify-writes stay under the lock: two threads
-            # activating entities concurrently must not overwrite each
-            # other's alive updates (each .at produces a NEW array from its
-            # thread's snapshot). Spawning still must not race run() — the
-            # step donates these buffers; activate entities between steps.
             base = int(self._shard_block[shard]) * self.eps
-            rows = np.arange(base + start_idx, base + start_idx + n_new,
-                             dtype=np.int32)
+        rows = np.arange(base + start_idx, base + start_idx + n_new,
+                         dtype=np.int32)
+        # device writes go under the ASK lock, not the registry lock: the
+        # step donates these buffers, so activation must never race an
+        # in-flight run, and two threads' read-modify-writes must not
+        # overwrite each other's alive updates (each .at produces a NEW
+        # array from its thread's snapshot). Taken OUTSIDE self._lock —
+        # the lock order everywhere is _ask_lock then _lock.
+        with self._ask_lock:
             sys = self.system
             sys.behavior_id = sys.behavior_id.at[jnp.asarray(rows)].set(0)
             sys.alive = sys.alive.at[jnp.asarray(rows)].set(True)
@@ -682,7 +674,9 @@ class DeviceShardRegion:
             payload_width=spec.payload_width, out_degree=spec.out_degree,
             host_inbox_per_shard=spec.host_inbox_per_shard,
             mailbox_slots=spec.mailbox_slots,
-            reroute_strays=True)
+            reroute_strays=True,
+            delivery_backend=spec.delivery_backend,
+            attention_latch_col="__promise_replied")
         new.flight_recorder = getattr(old, "flight_recorder", None)
         self.n_devices = n_surv
         self.blocks_per_device = self.total_blocks // n_surv

@@ -852,6 +852,84 @@ def bench_failover(n, steps=48, directory=None):
     }
 
 
+def bench_gateway_concurrency(region, per_leg: int = 192):
+    """Concurrency sweep (ISSUE 9): the same in-proc handle_frame mix
+    driven by 1 / 8 / 64 client threads, batched (AskBatcher coalescing)
+    vs serialized (`batch=False`, the PR 8 per-ask `_ask_lock` round)
+    A/B on one shared region. Every row is host-stamped (loadavg at
+    measurement time); batched rows carry the batcher's stats so the
+    artifact records the mean batch size the traffic actually got.
+
+    The point of the sweep: serialized throughput is flat in client
+    count (N clients pay N device rounds), batched throughput grows with
+    concurrency until the device saturates — the acceptance bar is
+    64-client batched >= 4x serialized with mean batch size > 1."""
+    import threading as _threading
+
+    from akka_tpu.gateway import (AdmissionController, GatewayServer,
+                                  RegionBackend, SloTracker)
+
+    def leg(clients: int, batched: bool):
+        backend = RegionBackend(region, batch=batched, max_batch=64)
+        slo = SloTracker(target_p50_ms=50.0, target_p99_ms=250.0)
+        adm = AdmissionController(rate=1e9, burst=1e9)
+        if batched:
+            slo.attach_batcher(backend.batcher)
+        srv = GatewayServer(None, backend, adm, slo)
+        per_client = max(1, per_leg // clients)
+        not_ok = []
+
+        def worker(w: int):
+            for i in range(per_client):
+                body = json.dumps(
+                    {"id": i, "tenant": f"t{w % 4}", "entity": f"cc{w}",
+                     "op": "add" if i % 4 else "get",
+                     "value": float(i % 5 + 1)}).encode()
+                rep = json.loads(srv.handle_frame(body))
+                if rep["status"] != "ok":
+                    not_ok.append(rep["status"])
+
+        threads = [_threading.Thread(target=worker, args=(w,))
+                   for w in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        n = per_client * clients
+        art = slo.artifact()
+        row = {"clients": clients,
+               "mode": "batched" if batched else "serialized",
+               "requests": n, "wall_s": round(dt, 3),
+               "req_per_sec": round(n / dt, 1),
+               "not_ok": len(not_ok),
+               "p50_ms": art["p50_ms"], "p99_ms": art["p99_ms"]}
+        try:
+            row["host_loadavg"] = round(os.getloadavg()[0], 2)
+        except OSError:
+            pass
+        if batched:
+            row["batch"] = backend.batcher.stats()
+            backend.close()
+        return row
+
+    sweep = [leg(c, batched) for c in (1, 8, 64)
+             for batched in (False, True)]
+
+    def rps(clients, mode):
+        return next(r["req_per_sec"] for r in sweep
+                    if r["clients"] == clients and r["mode"] == mode)
+
+    b64 = next(r for r in sweep
+               if r["clients"] == 64 and r["mode"] == "batched")
+    return {"sweep": sweep,
+            "speedup_64": round(rps(64, "batched") /
+                                max(rps(64, "serialized"), 1e-9), 2),
+            "mean_batch_size_64": round(
+                b64["batch"]["mean_batch_size"], 2)}
+
+
 def bench_gateway_slo(n_requests: int = 400, n_entities: int = 16):
     """gateway-slo: sustained request load through the serving gateway's
     in-proc ingress path (handle_frame -> admission -> region ask), two
@@ -907,9 +985,12 @@ def bench_gateway_slo(n_requests: int = 400, n_entities: int = 16):
     over = leg(rate=4.0, burst=4.0, n=n_requests)
     # conservation cross-check: every ok-acknowledged add is in the state
     total = backend.sum_all()
+    backend.close()
+    concurrency = bench_gateway_concurrency(region)
     return {"below_threshold": below, "overload": over,
             "entities_total": round(total, 1),
-            "shed_working": over["rejects"] > 0 and below["rejects"] == 0}
+            "shed_working": over["rejects"] > 0 and below["rejects"] == 0,
+            "concurrency": concurrency}
 
 
 def main() -> None:

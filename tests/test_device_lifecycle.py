@@ -99,6 +99,9 @@ def test_generation_guards_stop_respawn_race():
     assert s.read_state("n", fresh)[0] == 1
 
 
+@pytest.mark.slow  # 18s (ActorSystem + dispatcher spin-up): demoted to keep
+# the tier-1 suite under its 870s budget (PR 9); the system-level twin
+# test_generation_guards_stop_respawn_race keeps the guarantee in tier 1
 def test_device_ref_pins_incarnation():
     """The bridge-level form of the same guarantee: a DeviceActorRef captured
     before stop+respawn dead-letters its tells and fails its asks fast."""

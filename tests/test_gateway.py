@@ -389,6 +389,44 @@ def test_gateway_inproc_admin_and_errors(small_region):
         "unknown_admin_op:nope"
 
 
+def test_gateway_missing_entity_is_typed_and_not_charged():
+    """A frame with no `entity` key fast-fails BEFORE admission: typed
+    bad_request reply, the tenant's token bucket is never charged, and
+    the backend never sees the frame (previously this admitted, then
+    surfaced as fault:KeyError)."""
+    class NeverBackend:
+        def ask(self, entity_id, value):
+            raise AssertionError("backend must not see a malformed frame")
+
+    adm = AdmissionController(rate=1e6, burst=1e6)
+    slo = SloTracker()
+    srv = GatewayServer(None, NeverBackend(), adm, slo)
+    body = encode_body({"id": 7, "tenant": "t0", "op": "add", "value": 1.0})
+    rep = json.loads(srv.handle_frame(body))
+    assert rep["status"] == "error"
+    assert rep["reason"] == "bad_request:missing_entity"
+    assert adm.admitted == 0
+    assert slo.artifact()["errors"] == 1
+
+
+def test_gateway_fault_leg_records_latency():
+    """Generic backend faults record their latency like the timeout leg
+    always did, so error-leg p99s stay honest in the SLO artifact."""
+    class SlowBoom:
+        def ask(self, entity_id, value):
+            time.sleep(0.005)
+            raise RuntimeError("boom")
+
+    slo = SloTracker()
+    srv = GatewayServer(None, SlowBoom(),
+                        AdmissionController(rate=1e6, burst=1e6), slo)
+    rep = _req(srv, "t0", "e", "add", 1.0)
+    assert rep["status"] == "error" and rep["reason"] == "fault:RuntimeError"
+    art = slo.artifact()
+    assert art["errors"] == 1
+    assert art["p50_ms"] >= 4.0  # the fault's ~5ms landed in the window
+
+
 def test_gateway_ask_pool_exhaustion_becomes_shed(small_region):
     """The typed AskPoolExhausted fast-fail surfaces as a shed reply AND
     arms the admission cooldown (subsequent requests shed without touching

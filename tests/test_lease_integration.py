@@ -136,6 +136,9 @@ def test_file_lease_reacquire_own(tmp_path):
 
 # -- lease-majority SBR -------------------------------------------------------
 
+@pytest.mark.slow  # 17.5s (3 in-proc systems + partition detectors): demoted
+# to keep tier-1 under its 870s budget (PR 9); lease acquire/release and SBR
+# release-after-resolution stay covered by this module's tier-1 tests
 def test_lease_majority_sbr_resolves_partition(lease_cluster):
     """A 2/1 partition: whichever side acquires the lease survives; the
     other downs itself. With in-proc lease both sides race for real."""

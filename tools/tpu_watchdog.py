@@ -362,6 +362,7 @@ def on_tpu_found(detail: str) -> None:
         if gw:
             below = gw.get("below_threshold", {})
             over = gw.get("overload", {})
+            cc = gw.get("concurrency", {})
             append_log({"ts": _utcnow(), "ok": bool(gw.get("shed_working")),
                         "detail": "serving gateway SLO stats",
                         "p50_ms": below.get("p50_ms"),
@@ -369,6 +370,22 @@ def on_tpu_found(detail: str) -> None:
                         "req_per_sec": below.get("req_per_sec"),
                         "overload_reject_rate": over.get("reject_rate"),
                         "shed_working": gw.get("shed_working")})
+            if cc:
+                # batched-ask concurrency sweep (ISSUE 9): 64-client
+                # batched vs serialized A/B + the coalescing it achieved
+                b64 = next((r for r in cc.get("sweep", [])
+                            if r.get("clients") == 64
+                            and r.get("mode") == "batched"), {})
+                append_log({"ts": _utcnow(),
+                            "ok": cc.get("speedup_64", 0) >= 4.0 and
+                                  cc.get("mean_batch_size_64", 0) > 1.0,
+                            "detail": "gateway batched-ask concurrency",
+                            "speedup_64": cc.get("speedup_64"),
+                            "mean_batch_size_64":
+                                cc.get("mean_batch_size_64"),
+                            "batched64_req_per_sec":
+                                b64.get("req_per_sec"),
+                            "batched64_p99_ms": b64.get("p99_ms")})
     paths = [LOG, "watchdog_bench_full.out", "watchdog_attrib.out",
              "watchdog_trace.out", "watchdog_supervision.out",
              "watchdog_bridge.out", "watchdog_checkpoint.out",
