@@ -13,6 +13,8 @@ See BASELINE.json north star and SURVEY.md §7 step 2. Public surface:
     sys.tell(0, [1.0]); sys.run(100)
 """
 
+from .autoscale import (AutoscaleDecision, AutoscalePolicy,  # noqa: F401
+                        MeshAutoscaler, autoscaler_from_config)
 from .behavior import (BatchedBehavior, Ctx, Emit, Inbox, Mailbox,  # noqa: F401
                        behavior)
 from .bridge import (BatchedRuntimeHandle, DefaultCodec,  # noqa: F401

@@ -199,6 +199,7 @@ class BatchedRuntimeHandle:
                  sentinel_heartbeat_interval: float = 0.1,
                  sentinel_acceptable_pause: float = 3.0,
                  sentinel_max_failovers: int = 3,
+                 sentinel_depth_recovery_rounds: int = 64,
                  metrics_enabled: bool = False,
                  metrics_registry=None):
         self.capacity = capacity
@@ -257,6 +258,9 @@ class BatchedRuntimeHandle:
         # in stats for operator parity with the sharded runtime.
         from .sentinel import ShardProgressMonitor
         self.sentinel_max_failovers = int(sentinel_max_failovers)
+        # parity carry like max_failovers: the depth degrade-ladder only
+        # runs in MeshSentinel, but the knob rides the same config path
+        self.sentinel_depth_recovery_rounds = int(sentinel_depth_recovery_rounds)
         self._sentinel = ShardProgressMonitor(
             threshold=sentinel_threshold,
             heartbeat_interval=sentinel_heartbeat_interval,
@@ -1165,7 +1169,8 @@ class BatchedRuntimeHandle:
         failover budget carried for parity with MeshSentinel."""
         return {"drains": self._sentinel.drains,
                 "suspected": sorted(self._sentinel.suspected()),
-                "max_failovers": self.sentinel_max_failovers}
+                "max_failovers": self.sentinel_max_failovers,
+                "depth_recovery_rounds": self.sentinel_depth_recovery_rounds}
 
     def _sentinel_metrics(self) -> Dict[str, Any]:
         """sentinel_stats plus the numeric gauges the registry surfaces:

@@ -207,15 +207,18 @@ class MeshSentinel:
                  payload_dtype=jnp.float32, axis_name: str = "shards",
                  mailbox_slots: int = 0,
                  delivery_backend: Optional[str] = None,
+                 remote_capacity_per_pair: Optional[int] = None,
                  pipeline_depth: int = 2, min_pipeline_depth: int = 1,
                  checkpoint_interval_steps: int = 8,
                  checkpoint_keep: int = 3,
+                 wal_fsync_every_n: int = 1,
                  detector_threshold: float = 8.0,
                  heartbeat_interval: float = 0.1,
                  acceptable_pause: float = 1.0,
                  max_failovers: int = 3,
                  failover_min_backoff: float = 0.5,
                  failover_max_backoff: float = 30.0,
+                 depth_recovery_rounds: int = 64,
                  promise_rows: int = 0,
                  clock=_time.monotonic,
                  flight_recorder=None,
@@ -237,6 +240,7 @@ class MeshSentinel:
         self.axis_name = axis_name
         self.mailbox_slots = int(mailbox_slots)
         self.delivery_backend = delivery_backend
+        self.remote_capacity_per_pair = remote_capacity_per_pair
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_interval = int(checkpoint_interval_steps)
         self.checkpoint_keep = int(checkpoint_keep)
@@ -259,7 +263,8 @@ class MeshSentinel:
         from ..persistence.tell_journal import TellJournal
         os.makedirs(checkpoint_dir, exist_ok=True)
         self._journal = TellJournal(os.path.join(checkpoint_dir, "tells.wal"),
-                                    flight_recorder)
+                                    flight_recorder,
+                                    fsync_every_n=wal_fsync_every_n)
 
         self._monitor = ShardProgressMonitor(
             threshold=detector_threshold,
@@ -276,11 +281,31 @@ class MeshSentinel:
         self._step_lock = threading.RLock()
         self._inflight: deque = deque()  # attention-word handles, oldest first
         self._depth = int(pipeline_depth)
+        # degrade-ladder recovery (inverse of the post-failover halving):
+        # after depth_recovery_rounds consecutive healthy drains past the
+        # detection backoff window, _depth snaps back to the configured
+        # value. 0 disables (PR 5 behavior: halved forever).
+        self._depth_cfg = int(pipeline_depth)
+        self.depth_recovery_rounds = int(depth_recovery_rounds)
+        self._healthy_rounds = 0
         self._halted: Optional[str] = None
         self._failovers = 0
         self._detect_after = 0.0   # clock() before which suspicion is ignored
         self._mttr_t0: Optional[float] = None
         self.failover_stats: List[Dict[str, Any]] = []
+        # elastic mesh (scale_to): one record per voluntary re-shard, plus
+        # its own breaker/backoff so a flapping autoscaler (or a mesh that
+        # cannot rebuild wider) degrades to "stay at current width" instead
+        # of thrashing — the failover breaker stays reserved for losses
+        self.reshard_stats: List[Dict[str, Any]] = []
+        self._scale_breaker = CircuitBreaker(None,
+                                             max_failures=self.max_failovers,
+                                             call_timeout=float("inf"),
+                                             reset_timeout=1e9)
+        self._scale_failures = 0
+        self._scale_after = 0.0    # clock() before which scale_to refuses
+        self._snapshot_writer: Optional[threading.Thread] = None
+        self._autoscaler = None    # attach_autoscaler: polled per pump round
         self._snapshotted = False
         self._last_ckpt = 0
         self._spawned = False      # spawn topology freezes at first step
@@ -329,6 +354,8 @@ class MeshSentinel:
         # first build may round capacity up (divisibility); the rounded
         # value then pins the actor-id space for every rebuild
         cap = getattr(self, "capacity", None) or self._capacity_arg
+        extra = ({"remote_capacity_per_pair": self.remote_capacity_per_pair}
+                 if self.remote_capacity_per_pair is not None else {})
         sys_ = ShardedBatchedSystem(
             cap, behaviors, mesh=mesh,
             payload_width=self.payload_width, out_degree=self.out_degree,
@@ -338,7 +365,7 @@ class MeshSentinel:
             delivery_backend=self.delivery_backend,
             attention_latch_col=(self.PROMISE_REPLIED
                                  if self.promise_rows_n > 0 else None),
-            metrics_enabled=self.metrics_enabled)
+            metrics_enabled=self.metrics_enabled, **extra)
         sys_.flight_recorder = self.flight_recorder
         sys_.tell_journal = self._journal
         for b_idx, n, init in self._spawns:
@@ -431,6 +458,16 @@ class MeshSentinel:
             self._drain_one()
         if self._halted:
             raise SentinelHalted(self._halted)
+        if self._autoscaler is not None:
+            # one control tick per pump round, at the idle edge: the
+            # policy's hysteresis windows are therefore measured in pump
+            # rounds, and scale_to's drain loop is a no-op here
+            self._autoscaler.poll()
+
+    def attach_autoscaler(self, autoscaler) -> None:
+        """Poll `autoscaler` (batched/autoscale.MeshAutoscaler) once per
+        step() pump round; pass None to detach."""
+        self._autoscaler = autoscaler
 
     def _enqueue_step(self) -> None:
         if not self._snapshotted:
@@ -470,12 +507,29 @@ class MeshSentinel:
         self.system._note_shard_overflow(decode_attention(att))
         newly = self._monitor.observe(att)
         if newly:
+            self._healthy_rounds = 0
             if self.clock() < self._detect_after:
                 # post-failover backoff window: suspicion is deferred, not
                 # acted on — a still-frozen lane re-trips once it closes
                 self._monitor.unsuspect([s for s, _, _ in newly])
             else:
                 self._on_suspected(newly)
+        elif (self.depth_recovery_rounds > 0
+              and self._depth < self._depth_cfg
+              and self.clock() >= self._detect_after):
+            # degrade-ladder recovery: drains only count as healthy once
+            # the post-failover backoff window (where suspicion is merely
+            # DEFERRED) has closed; a full quiet window restores the
+            # configured speculation depth the halving took away
+            self._healthy_rounds += 1
+            if self._healthy_rounds >= self.depth_recovery_rounds:
+                restored_from, self._depth = self._depth, self._depth_cfg
+                self._healthy_rounds = 0
+                if self.flight_recorder is not None:
+                    self.flight_recorder.event(
+                        "pipeline_depth_restored", system="sentinel",
+                        from_depth=restored_from, to_depth=self._depth_cfg,
+                        step=int(self.system._host_step))
 
     def poll(self) -> None:
         """Wall-clock deadline lane for the no-drain/hung-dispatch case:
@@ -545,8 +599,10 @@ class MeshSentinel:
                 return
             # degrade ladder: every failover after the first halves the
             # pipeline depth — less speculation on a mesh that keeps dying
+            # (recovers via depth_recovery_rounds healthy drains)
             if self._failovers > 1:
                 self._depth = max(self.min_pipeline_depth, self._depth // 2)
+            self._healthy_rounds = 0
             self._detect_after = self.clock() + backoff_delay(
                 self._failovers, self._fo_min_backoff, self._fo_max_backoff)
             self._monitor.reset()
@@ -579,6 +635,160 @@ class MeshSentinel:
             self._promise_free = list(range(self.promise_rows_n))
             self._zombies.clear()
         self._last_ckpt = self.system._host_step
+
+    # ---------------------------------------------------------- elastic mesh
+    def scale_to(self, devices: Sequence[Any], trigger: str = "manual",
+                 signal: str = "manual",
+                 value: float = 0.0) -> Optional[Dict[str, Any]]:
+        """Bounded-pause live re-shard onto `devices` (grow or shrink) —
+        the inverse of `_failover`, minus the loss. Under the step lock:
+        drain the depth-k pipeline to the checkpoint barrier, host-gather
+        the slab tree at the frontier, rebuild the ShardedBatchedSystem on
+        the new mesh (make_mesh(devices=...)) and restore straight from
+        the IN-MEMORY tree — `_restore_resharded` re-places rows and the
+        WAL tail re-stages journaled-but-undispatched tells — then resume.
+        The fsync'd snapshot write and journal compaction run on a
+        background thread once the barrier state is captured: durability
+        overlaps the rebuild instead of sitting inside the pause.
+
+        Outstanding asks SURVIVE (unlike a failover): the tree is taken at
+        the live frontier, so the promise reply/replied columns carry over
+        bit-exactly and waiters resolve on post-re-shard drains.
+
+        Returns the reshard_stats record (pause_s included), or None when
+        `devices` already is the current mesh. Raises SentinelHalted when
+        halted, ValueError on a width that does not divide capacity, and
+        RuntimeError when the scale breaker is open or the anti-thrash
+        backoff window has not closed. A rebuild failure rolls back to the
+        still-healthy current mesh and counts against the scale breaker."""
+        devices = list(devices)
+        t0 = _time.perf_counter()
+        with self._step_lock:
+            if self._halted:
+                raise SentinelHalted(self._halted)
+            if len(devices) < 1:
+                raise ValueError("cannot scale to zero devices")
+            if self._scale_breaker.state == "open":
+                raise RuntimeError(
+                    f"scale breaker open after {self._scale_failures} "
+                    f"failed re-shards: mesh stays at {len(self.devices)}")
+            if self.clock() < self._scale_after:
+                raise RuntimeError(
+                    "re-shard refused: anti-thrash backoff window closes "
+                    f"at clock {self._scale_after:.3f}")
+            # drain to the barrier first — a suspicion surfacing on the way
+            # down fails over (and may shrink self.devices) before we
+            # commit to a target width against the post-drain mesh
+            while self._inflight:
+                self._drain_one()
+            if self._halted:
+                raise SentinelHalted(self._halted)
+            old_devices = list(self.devices)
+            old_n, new_n = len(old_devices), len(devices)
+            if devices == old_devices:
+                return None
+            if self.capacity % new_n != 0:
+                raise ValueError(
+                    f"capacity {self.capacity} is not divisible by {new_n} "
+                    f"shards: provision capacity as a multiple of every "
+                    f"mesh width to scale to (docs/ELASTIC_MESH.md)")
+            self.system.block_until_ready()
+            step = int(self.system._host_step)
+            from ..persistence.slab_snapshot import slab_pytree
+            tree = slab_pytree(self.system)
+            self._spawn_snapshot_writer(tree, step)
+            old_system = self.system
+            try:
+                self.devices = devices
+                self.system = self._build_system()
+                self.system.restore_tree(tree, journal=self._journal)
+            except Exception:
+                # the old mesh is still healthy — scale-out is an
+                # optimization, never a reason to go down
+                self.devices, self.system = old_devices, old_system
+                self._scale_failures += 1
+                self._scale_breaker.fail()
+                self._scale_after = self.clock() + backoff_delay(
+                    self._scale_failures, self._fo_min_backoff,
+                    self._fo_max_backoff)
+                raise
+            self._snapshotted = True
+            self._last_ckpt = step
+            self._monitor.reset()   # shard indices renumbered
+            self._healthy_rounds = 0
+            self._detect_after = self.clock() + self._fo_min_backoff
+            self._scale_after = self.clock() + self._fo_min_backoff
+            pause = _time.perf_counter() - t0
+            grow = new_n > old_n
+            rec = {
+                "at_clock": float(self.clock()),
+                "direction": "grow" if grow else "shrink",
+                "from_shards": old_n,
+                "to_shards": new_n,
+                "trigger": trigger,
+                "signal": signal,
+                "value": float(value),
+                "step": step,
+                "pause_s": pause,
+            }
+            self.reshard_stats.append(rec)
+            fr = self.flight_recorder
+            if fr is not None:
+                if grow:
+                    for s in range(old_n, new_n):
+                        fr.device_rejoined("sentinel", shard=s, step=step)
+                    fr.mesh_expanded("sentinel", from_shards=old_n,
+                                     to_shards=new_n, step=step,
+                                     pause_s=pause, trigger=trigger)
+                else:
+                    fr.mesh_narrowed("sentinel", from_shards=old_n,
+                                     to_shards=new_n, step=step,
+                                     pause_s=pause, trigger=trigger)
+            return rec
+
+    def expand(self, returned: Sequence[Any],
+               trigger: str = "device_rejoined",
+               signal: str = "manual",
+               value: float = 0.0) -> Optional[Dict[str, Any]]:
+        """Hot scale-out when evicted devices return (or fresh capacity is
+        added): widen the mesh to current + `returned`. Devices already in
+        the mesh are skipped, so re-announcing a device is idempotent."""
+        current = list(self.devices)
+        added = [d for d in returned if d not in current]
+        if not added:
+            return None
+        return self.scale_to(current + added, trigger=trigger,
+                             signal=signal, value=value)
+
+    def _spawn_snapshot_writer(self, tree, step: int) -> None:
+        """Durability off the pause path: write the fsync'd snapshot file,
+        compact the WAL only AFTER its covering snapshot is durable (the
+        recovery invariant), then GC retained snapshots — all overlapping
+        the mesh rebuild on a daemon thread. Re-shards serialize on the
+        previous writer; compaction racing the main thread's WAL replay is
+        safe (TellJournal.compact is atomic-replace under the journal
+        lock, and readers on the old inode see identical live records)."""
+        prev = self._snapshot_writer
+        if prev is not None and prev.is_alive():
+            prev.join()
+
+        def write() -> None:
+            try:
+                from ..persistence.slab_snapshot import (gc_slabs,
+                                                         save_slab_tree)
+                save_slab_tree(tree, self.checkpoint_dir, step)
+                self._journal.compact(step)
+                gc_slabs(self.checkpoint_dir, self.checkpoint_keep)
+            except Exception as e:  # noqa: BLE001 — durability degraded,
+                #                     the live re-shard itself succeeded
+                if self.flight_recorder is not None:
+                    self.flight_recorder.checkpoint_failed(
+                        "sentinel", str(e), 1)
+
+        t = threading.Thread(target=write, daemon=True,
+                             name="sentinel-reshard-snapshot")
+        self._snapshot_writer = t
+        t.start()
 
     def _halt(self, reason: str) -> None:
         self._halted = reason
@@ -652,8 +862,19 @@ class MeshSentinel:
 
     def _lower_latches(self, slots) -> None:
         from jax.sharding import NamedSharding, PartitionSpec as P
-        rows = jnp.asarray(np.asarray(
-            [self._promise_base + int(s) for s in slots], np.int32))
+        rows_list = [self._promise_base + int(s) for s in slots]
+        if not rows_list:
+            return
+        # pow2-with-floor-64 padding (the _flush_staged rule): lowering a
+        # duplicated row to False twice is idempotent, and the padded shape
+        # keeps this eager scatter to a handful of compiled programs —
+        # unpadded, every distinct resolve-batch size (and every re-shard's
+        # full-pool lower on a NEW mesh) paid a fresh ~1s CPU compile
+        n = len(rows_list)
+        pad = max(64, 1 << (n - 1).bit_length()) - n
+        if pad:
+            rows_list.extend(rows_list[:1] * pad)
+        rows = jnp.asarray(np.asarray(rows_list, np.int32))
         shard = NamedSharding(self.system.mesh, P(self.axis_name))
         col = self.system.state[self.PROMISE_REPLIED]
         self.system.state[self.PROMISE_REPLIED] = jax.device_put(
@@ -685,14 +906,20 @@ class MeshSentinel:
         return self.system.read_attention()
 
     def sentinel_stats(self) -> Dict[str, Any]:
+        reshards = [dict(s) for s in self.reshard_stats]
         return {
             "devices": len(self.devices),
             "failovers": self._failovers,
             "halted": self._halted,
             "pipeline_depth": self._depth,
+            "pipeline_depth_configured": self._depth_cfg,
             "drains": self._monitor.drains,
             "suspected": sorted(self._monitor.suspected()),
             "failover_stats": [dict(s) for s in self.failover_stats],
+            "reshards": len(reshards),
+            "reshard_stats": reshards,
+            "last_reshard_pause_ms": (reshards[-1]["pause_s"] * 1e3
+                                      if reshards else 0.0),
         }
 
     def _sentinel_metrics(self) -> Dict[str, Any]:
@@ -702,6 +929,7 @@ class MeshSentinel:
         st = self.sentinel_stats()
         st["suspected_count"] = len(st.pop("suspected", ()))
         st.pop("failover_stats", None)
+        st.pop("reshard_stats", None)
         st.pop("halted", None)
         phi = 0.0
         for s in range(len(self.devices)):
@@ -728,6 +956,9 @@ class MeshSentinel:
             reg.set_step(host_step)
 
     def shutdown(self) -> None:
+        writer = self._snapshot_writer
+        if writer is not None and writer.is_alive():
+            writer.join()  # snapshot durability before the journal closes
         with self._step_lock:
             self._inflight.clear()
             self._fail_waiters(SentinelHalted("sentinel shut down"))

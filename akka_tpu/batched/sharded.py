@@ -482,14 +482,33 @@ class ShardedBatchedSystem:
             if start + n > self.capacity:
                 raise RuntimeError("actor capacity exhausted")
             self._next_row = start + n
-        sl = slice(start, start + n)
-        self.behavior_id = self.behavior_id.at[sl].set(b_idx)
-        self.alive = self.alive.at[sl].set(True)
+        # pow2-with-floor-64 padded index scatter (the _flush_staged rule):
+        # a duplicated leading index re-set to the identical value is
+        # idempotent, and the padded shape bounds the compiled-scatter
+        # count. Unpadded slice-sets compile one program per distinct
+        # block length AND per mesh — on a failover/scale re-shard every
+        # replayed spawn block would pay a fresh ~1s eager XLA compile on
+        # CPU, dominating the measured re-shard pause.
+        pad = max(64, 1 << (n - 1).bit_length()) - n
+        rows_np = np.arange(start, start + n, dtype=np.int32)
+        idx = jnp.asarray(np.concatenate(
+            [rows_np, np.full(pad, start, np.int32)]) if pad else rows_np)
+        self.behavior_id = self.behavior_id.at[idx].set(b_idx)
+        self.alive = self.alive.at[idx].set(True)
         if init_state:
             for col, value in init_state.items():
-                self.state[col] = self.state[col].at[sl].set(
-                    jnp.asarray(value, dtype=self.state[col].dtype))
-        return np.arange(start, start + n, dtype=np.int32)
+                cur = self.state[col]
+                v = jnp.asarray(value, dtype=cur.dtype)
+                if v.ndim == cur.ndim and v.shape[0] == n:
+                    # per-row values: pad rows exactly like the indices
+                    if pad:
+                        v = jnp.concatenate(
+                            [v, jnp.broadcast_to(v[:1],
+                                                 (pad,) + v.shape[1:])])
+                    self.state[col] = cur.at[idx].set(v)
+                else:
+                    self.state[col] = cur.at[idx].set(v)
+        return rows_np
 
     def tell(self, dst: int, payload, mtype: int = 0) -> None:
         pl = np.zeros(self.payload_width, dtype=jnp.dtype(self.payload_dtype))
@@ -825,15 +844,19 @@ class ShardedBatchedSystem:
         return step, slab_dict(self.metrics)
 
     # ------------------------------------------------- checkpoint / recovery
-    def checkpoint(self, directory: str, keep: Optional[int] = None) -> str:
+    def checkpoint(self, directory: str, keep: Optional[int] = None,
+                   compact: bool = True) -> str:
         """Checkpoint barrier (see BatchedSystem.checkpoint): quiesce on
         the non-donated step_count, snapshot the schema-v3 slab pytree
         (slab_snapshot host-gathers the mesh-sharded slabs), compact the
-        attached tell journal, GC retained snapshots."""
+        attached tell journal, GC retained snapshots. `compact=False`
+        defers the fsync'd journal rewrite — the hot re-shard path
+        (sentinel.scale_to) compacts AFTER the pipeline resumes so the
+        rewrite never sits inside the measured pause."""
         from ..persistence.slab_snapshot import gc_slabs, save_slabs
         self.block_until_ready()
         path = save_slabs(self, directory)
-        if self.tell_journal is not None:
+        if self.tell_journal is not None and compact:
             self.tell_journal.compact(self._host_step)
         if keep is not None:
             gc_slabs(directory, keep)
@@ -849,10 +872,17 @@ class ShardedBatchedSystem:
         builds a same-capacity system and re-runs its spawns first (see
         BatchedSystem.restore). With `journal` set, journaled batches past
         the snapshot step replay to the crash frontier."""
-        from ..persistence.slab_snapshot import (load_slab_tree,
-                                                 restore_slab_pytree)
+        from ..persistence.slab_snapshot import load_slab_tree
+        return self.restore_tree(load_slab_tree(path), journal=journal)
+
+    def restore_tree(self, tree: Dict[str, Any], journal=None) -> int:
+        """Restore from an already-loaded slab pytree (`slab_pytree` host
+        copies). The hot re-shard path (sentinel.scale_to) takes the tree
+        at the drain barrier and restores through HERE, skipping the disk
+        round trip entirely — the fsync'd file write runs concurrently as
+        durability, not as pause."""
+        from ..persistence.slab_snapshot import restore_slab_pytree
         from ..persistence.tell_journal import replay_journal
-        tree = load_slab_tree(path)
         snap_rows = int(np.asarray(tree["behavior_id"]).shape[0])
         if snap_rows != self.capacity:
             raise ValueError(f"snapshot capacity {snap_rows} != "

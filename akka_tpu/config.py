@@ -194,6 +194,11 @@ def reference_config() -> Config:
                     "sentinel-heartbeat-interval": "100ms",
                     "sentinel-acceptable-pause": "3s",
                     "sentinel-max-failovers": 3,
+                    # degrade-ladder recovery: failover halves the
+                    # speculation depth; this many consecutive healthy
+                    # pump rounds restore the configured depth (0 = the
+                    # halving is permanent, the pre-PR-10 behavior)
+                    "sentinel-depth-recovery-rounds": 64,
                     "mesh-axes": {},
                     # per-dispatcher override of akka.metrics.enabled:
                     # compiles the device metric slab into this
@@ -250,6 +255,25 @@ def reference_config() -> Config:
                 "http-port": 0,
                 "jsonl-path": "",
                 "jsonl-interval": "1s",
+            },
+            # elastic mesh autoscaler (batched/autoscale.py): off by
+            # default — when enabled, autoscaler_from_config attaches a
+            # MeshAutoscaler to the MeshSentinel, polled once per pump
+            # round. Thresholds are per-poll growth deltas for the
+            # counters and levels for the occupancies; hysteresis windows
+            # are counted in polls (= pump rounds). max-shards 0 means
+            # pool-bounded. docs/ELASTIC_MESH.md has tuning guidance.
+            "autoscale": {
+                "enabled": False,
+                "min-shards": 1,
+                "max-shards": 0,
+                "widen-after-polls": 3,
+                "narrow-after-polls": 16,
+                "cooldown-polls": 8,
+                "overflow-threshold": 1.0,
+                "dropped-threshold": 1.0,
+                "ask-occupancy-threshold": 0.9,
+                "occupancy-p90-threshold": float("inf"),
             },
             "remote": {
                 "canonical": {"hostname": "127.0.0.1", "port": 0},

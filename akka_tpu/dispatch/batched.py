@@ -90,6 +90,9 @@ class TpuBatchedDispatcher(Dispatcher):
                     sentinel_max_failovers=overrides.get(
                         "sentinel_max_failovers",
                         c.get_int("sentinel-max-failovers", 3)),
+                    sentinel_depth_recovery_rounds=overrides.get(
+                        "sentinel_depth_recovery_rounds",
+                        c.get_int("sentinel-depth-recovery-rounds", 64)),
                     # telemetry plane: the system-level akka.metrics.enabled
                     # switch (or an explicit override) compiles the device
                     # metric slab in; the system-owned registry is shared

@@ -108,6 +108,25 @@ class FlightRecorder:
     def shard_overflow(self, system: str, shard: int, mailbox_overflow: int,
                        dropped: int) -> None: ...
 
+    # elastic mesh (batched/sentinel.scale_to + batched/autoscale.py):
+    # device_rejoined per device added back on a grow; mesh_expanded /
+    # mesh_narrowed after the bounded-pause live re-shard resumes
+    # (pause_s = drain -> first dispatch on the new mesh is ready);
+    # autoscale_decision records WHY the policy acted (trigger signal +
+    # its observed value) with the measured pause — the operator-facing
+    # audit trail of every mesh-size change
+    def device_rejoined(self, system: str, shard: int, step: int) -> None: ...
+
+    def mesh_expanded(self, system: str, from_shards: int, to_shards: int,
+                      step: int, pause_s: float, trigger: str) -> None: ...
+
+    def mesh_narrowed(self, system: str, from_shards: int, to_shards: int,
+                      step: int, pause_s: float, trigger: str) -> None: ...
+
+    def autoscale_decision(self, system: str, direction: str, signal: str,
+                           value: float, from_shards: int, to_shards: int,
+                           pause_ms: float) -> None: ...
+
     # -- generic escape hatch ------------------------------------------------
     def event(self, name: str, **fields: Any) -> None: ...
 

@@ -192,24 +192,16 @@ def region_pressure_signals(region) -> Dict[str, Callable[[], float]]:
     Overflow counters are CUMULATIVE: the signal is their GROWTH since
     the previous poll (device mail being lost right now), so thresholds
     compare against a per-interval delta, and a long-dead spike does not
-    shed forever."""
-    last = {"mail": 0.0, "drop": 0.0}
+    shed forever.
 
-    def mail_delta() -> float:
-        v = float(region.system.mailbox_overflow)
-        d, last["mail"] = v - last["mail"], v
-        return d
-
-    def drop_delta() -> float:
-        import numpy as np
-        v = float(np.sum(region.system.dropped_per_shard))
-        d, last["drop"] = v - last["drop"], v
-        return d
-
-    return {"mailbox_overflow": mail_delta,
-            "exchange_dropped": drop_delta,
-            "ask_pool_occupancy":
-                lambda: float(region.ask_pool_stats()["occupancy"])}
+    The delta/clamp bookkeeping lives in event/pressure.PressureReader —
+    the SAME class the mesh autoscaler polls, so admission shedding and
+    autoscaling can never disagree about what "pressure" means. Each
+    caller gets its OWN reader (own baselines): the two consumers poll at
+    different cadences and must not steal each other's deltas."""
+    from ..event.pressure import PressureReader, system_pressure_sources
+    return PressureReader(system_pressure_sources(
+        region, ask_pool_stats=region.ask_pool_stats)).signals()
 
 
 def handle_pressure_signals(handle) -> Dict[str, Callable[[], float]]:

@@ -61,6 +61,7 @@ class SloTracker:
         self._lat_sorted = (-1, [])
         self._hist = None
         self._batcher = None
+        self._autoscaler = None
         if registry is not None:
             registry.register_collector("gateway", self._collect)
             self._hist = registry.histogram(
@@ -75,6 +76,14 @@ class SloTracker:
         histograms live on the MetricsRegistry; this is the stable-schema
         summary next to the latency numbers it explains."""
         self._batcher = batcher
+
+    def attach_autoscaler(self, autoscaler) -> None:
+        """Carry the elastic-mesh summary (MeshAutoscaler.stats: widened/
+        narrowed counts, current width, last trigger signal and pause) in
+        artifact() as `autoscale` — an operator reading slo.json sees
+        WHETHER the mesh moved under the latency numbers, and what it cost.
+        Same stable-schema-summary contract as `ask_batch`."""
+        self._autoscaler = autoscaler
 
     # -------------------------------------------------------------- record
     def record(self, tenant: str, outcome: str,
@@ -119,8 +128,11 @@ class SloTracker:
         step = self.registry.step if self.registry is not None else 0
         batch = ({"ask_batch": self._batcher.stats()}
                  if self._batcher is not None else {})
+        scale = ({"autoscale": self._autoscaler.stats()}
+                 if self._autoscaler is not None else {})
         return {
             **batch,
+            **scale,
             "requests": total,
             "ok": counts["ok"],
             "rejects": counts["reject"],

@@ -171,7 +171,17 @@ def _try_orbax():
 
 def save_slabs(system, directory: str, step: Optional[int] = None) -> str:
     """Snapshot `system` under `directory`; returns the checkpoint path."""
-    tree = jax.tree_util.tree_map(np.asarray, slab_pytree(system))
+    return save_slab_tree(slab_pytree(system), directory, step)
+
+
+def save_slab_tree(tree: Dict[str, Any], directory: str,
+                   step: Optional[int] = None) -> str:
+    """Serialize an already host-gathered slab pytree (`slab_pytree`
+    output) under `directory`. Split from save_slabs so the hot re-shard
+    path (sentinel.scale_to) can take the host copies at the drain barrier
+    and overlap THIS — the fsync'd disk write — with the rebuild on the
+    new mesh, restoring directly from the in-memory tree."""
+    tree = jax.tree_util.tree_map(np.asarray, tree)
     ocp = _try_orbax()
     name = f"slab-{step if step is not None else int(tree['step_count'])}"
     path = os.path.join(os.path.abspath(directory), name)

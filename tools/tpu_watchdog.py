@@ -77,6 +77,45 @@ def _utcnow() -> str:
         timespec="seconds")
 
 
+def tunnel_probe(timeout_s: float = 3.0) -> dict:
+    """Transport liveness BELOW jax (ROADMAP #5): plain TCP connects to
+    the tunnel endpoint(s), recorded on every probe row. Separates the
+    two failure modes five rounds could not tell apart — a wedged
+    `initialize_pjrt_plugin` hangs ABOVE a live socket (tunnel_ok=True,
+    jax probe dead), while a dead transport refuses/times out the raw
+    connect (tunnel_ok=False explains the jax hang). Endpoints come from
+    PALLAS_AXON_POOL_IPS (the ambient sitecustomize's pool, comma-
+    separated ip[:port]) with TPU_TUNNEL_PORT as the default port; no
+    jax import anywhere near this path, so the check stays cheap and
+    unhangable."""
+    import socket
+    raw = os.environ.get("PALLAS_AXON_POOL_IPS", "").strip()
+    try:
+        default_port = int(os.environ.get("TPU_TUNNEL_PORT", "8471"))
+    except ValueError:
+        default_port = 8471
+    if not raw:
+        return {"configured": False}
+    rows = []
+    for ent in raw.split(","):
+        ent = ent.strip()
+        if not ent:
+            continue
+        host, _, port = ent.partition(":")
+        addr = (host, int(port) if port.isdigit() else default_port)
+        t0 = time.time()
+        try:
+            with socket.create_connection(addr, timeout=timeout_s):
+                rows.append({"addr": f"{addr[0]}:{addr[1]}", "ok": True,
+                             "connect_ms": round((time.time() - t0) * 1e3,
+                                                 1)})
+        except OSError as e:
+            rows.append({"addr": f"{addr[0]}:{addr[1]}", "ok": False,
+                         "error": f"{type(e).__name__}: {e}"[:120]})
+    return {"configured": True, "ok": any(r["ok"] for r in rows),
+            "endpoints": rows}
+
+
 def _split_expose(stdout: str) -> tuple[str, str | None]:
     """(device detail line, exposition text or None) from probe stdout."""
     head, sep, rest = stdout.partition("---EXPOSE---")
@@ -386,11 +425,47 @@ def on_tpu_found(detail: str) -> None:
                             "batched64_req_per_sec":
                                 b64.get("req_per_sec"),
                             "batched64_p99_ms": b64.get("p99_ms")})
+    # elastic mesh on-chip: chained live re-shards (2->4->8->4) with the
+    # scale-out pause measured against a cold restore of the SAME
+    # snapshot (docs/ELASTIC_MESH.md budgets pause <= 2x restore) plus
+    # the autoscale closed loop's wide-over-degraded goodput ratio
+    run_logged("reshard", [sys.executable, "bench.py", "--config",
+                           "reshard-pause", "--probe-timeout", "120"],
+               timeout_s=1800)
+    rp_out = os.path.join(REPO, "watchdog_reshard.out")
+    if os.path.exists(rp_out):
+        rj = None
+        for line in open(rp_out):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    rj = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
+        rs = (rj or {}).get("extra", {}).get("reshard", {})
+        if rs:
+            sized = {k: v for k, v in rs.items() if k.startswith("rows_")}
+            transitions = {
+                k: [{"t": f"{t['from_shards']}->{t['to_shards']}",
+                     "pause_s": t["pause_s"], "restore_s": t["restore_s"],
+                     "ok": t["ok"]}
+                    for t in v.get("transitions", [])]
+                for k, v in sized.items()}
+            au = rs.get("autoscale", {})
+            append_log({"ts": _utcnow(), "ok": bool(rs.get("ok")),
+                        "detail": "live re-shard pause stats "
+                                  "(pause <= 2x cold restore per row)",
+                        "transitions": transitions,
+                        "autoscale_widened": au.get("widened"),
+                        "autoscale_narrowed": au.get("narrowed"),
+                        "wide_over_degraded": au.get("wide_over_degraded"),
+                        "widen_signal": au.get("widen_signal"),
+                        "widen_pause_ms": au.get("widen_pause_ms")})
     paths = [LOG, "watchdog_bench_full.out", "watchdog_attrib.out",
              "watchdog_trace.out", "watchdog_supervision.out",
              "watchdog_bridge.out", "watchdog_checkpoint.out",
              "watchdog_metrics.out", "watchdog_failover.out",
-             "watchdog_gateway.out"]
+             "watchdog_gateway.out", "watchdog_reshard.out"]
     if last is not None:
         paths.append("BENCH_TPU.json")
     if os.path.isdir(os.path.join(REPO, "traces/tpu_r05")):
@@ -414,9 +489,11 @@ def main() -> None:
         n_probe += 1
         is_long = long_every > 0 and n_probe % long_every == 0
         t0 = time.time()
+        tun = tunnel_probe()
         ok, detail, expose = probe(long_timeout if is_long else timeout)
         rec = {"ts": _utcnow(), "ok": ok, "detail": detail,
-               "probe_s": round(time.time() - t0, 1)}
+               "probe_s": round(time.time() - t0, 1),
+               "tunnel": tun}
         if is_long:
             rec["long_timeout_s"] = long_timeout
         if expose is not None:
