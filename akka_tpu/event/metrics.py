@@ -119,6 +119,27 @@ class Histogram:
         if step is not None:
             self.step = int(step)
 
+    def observe_many(self, vs, step: Optional[int] = None) -> None:
+        """Vectorized observe for a whole wave of samples (the binary
+        ingress path records per-window): one bincount instead of N
+        scalar bucket updates. Bucket math matches _host_bucket exactly
+        (bit_length of the integer part, clamped)."""
+        arr = np.asarray(vs, np.float64).reshape(-1)
+        if arr.size == 0:
+            return
+        idx = np.where(
+            arr < 1.0, 0,
+            np.minimum(
+                np.frexp(np.maximum(arr, 1.0).astype(np.int64)
+                         .astype(np.float64))[1],
+                _HOST_BUCKETS - 1))
+        self._buckets += np.bincount(idx.astype(np.int64),
+                                     minlength=_HOST_BUCKETS)
+        self._count += int(arr.size)
+        self._sum += float(arr.sum())
+        if step is not None:
+            self.step = int(step)
+
     def percentile(self, q: float) -> float:
         if self._count == 0:
             return 0.0

@@ -10,12 +10,15 @@ Three planes, each its own module:
 from .admission import (AdmissionController, AskPoolExhausted, Reject,
                         TokenBucket, handle_pressure_signals,
                         region_pressure_signals)
-from .ingress import (GatewayClient, GatewayServer, RegionBackend,
-                      counter_behavior, encode_frame, FrameReader)
+from .ingress import (DEFAULT_MAX_FRAME, GatewayClient, GatewayServer,
+                      RegionBackend, counter_behavior, encode_body,
+                      encode_frame, FrameReader)
 from .slo import SloTracker
+from ..serialization import frames
 
 __all__ = ["AdmissionController", "AskPoolExhausted", "Reject",
            "TokenBucket", "handle_pressure_signals",
            "region_pressure_signals", "GatewayClient", "GatewayServer",
-           "RegionBackend", "counter_behavior", "encode_frame",
-           "FrameReader", "SloTracker"]
+           "RegionBackend", "counter_behavior", "encode_body",
+           "encode_frame", "FrameReader", "SloTracker", "frames",
+           "DEFAULT_MAX_FRAME"]

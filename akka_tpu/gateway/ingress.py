@@ -1,30 +1,55 @@
 """Ingress: the framed-TCP front door onto sharded device entities.
 
 Wire protocol — `simpleFramingProtocol` (stream/framing.py): every frame
-is `[u32 big-endian length][JSON body]`. Requests:
+is `[u32 big-endian length][body]`, and TWO body encodings coexist on
+one connection, sniffed by the first body byte (ISSUE 11):
 
-    {"id": 7, "tenant": "t0", "entity": "acct-42", "op": "add", "value": 3}
+- **JSON** (first byte `{`) — the debuggable fallback and the admin
+  channel. Requests:
 
-ops: "add" (apply value, reply new total — the acknowledged write),
-"get" (read total). Replies:
+      {"id": 7, "tenant": "t0", "entity": "acct-42", "op": "add",
+       "value": 3}
 
-    {"id": 7, "status": "ok", "value": 45.0}
-    {"id": 8, "status": "shed", "reason": "rate_limited",
-     "retry_after_ms": 120}
-    {"id": 9, "status": "error", "reason": "timeout"}
+  ops: "add" (apply value, reply new total — the acknowledged write),
+  "get" (read total). Replies:
+
+      {"id": 7, "status": "ok", "value": 45.0}
+      {"id": 8, "status": "shed", "reason": "rate_limited",
+       "retry_after_ms": 120}
+      {"id": 9, "status": "error", "reason": "timeout"}
+
+- **Binary** (first byte 0xAB — serialization/frames.py): a versioned
+  fixed-schema batch of packed request records. A whole window decodes
+  in ONE `np.frombuffer` pass into columns (op, entity, value) that
+  feed the columnar ask wave (`RegionBackend.ask_many` ->
+  `AskBatcher.ask_many` -> `execute_ask_batch`'s coalesced flush), and
+  the reply wave encodes in one vectorized pass — zero per-request
+  dict/object construction between wire bytes and the staging slab. A
+  batch of one is the solo ask, bit-identical to its JSON twin.
 
 "shed" is the admission layer speaking (typed backpressure — the client
 knows why and when to retry); "error" is the runtime (ask timeout or
 fault). The operator tenant `__admin` bypasses admission and reaches
 control ops (sum / checkpoint / rebalance / failover / artifact / stats)
 through the same front door — chaos is injected over the wire, the way
-an operator would.
+an operator would. Admin ops are JSON-only (a binary frame addressed to
+the admin tenant gets a typed error): the operator channel stays
+human-readable.
 
 Request path: TCP bytes -> length-field decode -> handle_frame (admission
 -> SLO clock -> backend ask) -> length-prefix encode -> TCP bytes. The
 per-connection flow is ack-gated by the stream TCP layer (ONE Write in
 flight), so a slow consumer throttles the producer instead of growing an
-unbounded buffer — tested in tests/test_gateway.py.
+unbounded buffer — tested in tests/test_gateway.py. In-proc transports
+(bench, batched load generators) can additionally hand
+`handle_frame_batch` a window of frames: contiguous binary frames merge
+into one decode + one ask wave.
+
+ONE frame-size limit (`frames.DEFAULT_MAX_FRAME`) is the default at
+BOTH ends — the server's framing stages and the client's FrameReader —
+so a server-legal reply can never exceed what the client will reassemble
+(the 1<<20 / 1<<16 mismatch is gone; pass `max_frame` to both ends
+together to change it).
 
 `handle_frame` is transport-free: the tier-1 smoke test and the
 gateway-slo bench drive it in-proc; the chaos tier drives it over real
@@ -44,35 +69,49 @@ import json
 import socket
 import struct
 import time
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..batched.bridge import AskPoolExhausted
+from ..serialization import frames
 from .admission import AdmissionController, Reject
 from .slo import SloTracker
 
-__all__ = ["encode_frame", "FrameReader", "counter_behavior",
-           "RegionBackend", "GatewayServer", "GatewayClient"]
+__all__ = ["encode_frame", "encode_body", "FrameReader", "counter_behavior",
+           "RegionBackend", "GatewayServer", "GatewayClient",
+           "DEFAULT_MAX_FRAME"]
 
 ADMIN_TENANT = "__admin"
 
+# one limit, both ends (see module docstring)
+DEFAULT_MAX_FRAME = frames.DEFAULT_MAX_FRAME
+
 
 # ---------------------------------------------------------------- wire codec
+def encode_body(obj: Dict[str, Any]) -> bytes:
+    """JSON reply/request body only — the stream encoder stage (or the
+    in-proc caller) adds the length prefix."""
+    return json.dumps(obj, separators=(",", ":")).encode("utf-8")
+
+
 def encode_frame(obj: Dict[str, Any]) -> bytes:
-    body = json.dumps(obj, separators=(",", ":")).encode("utf-8")
-    return struct.pack(">I", len(body)) + body
+    """Length-prefixed JSON frame: the ONE frame-encode helper (shared
+    by server, client and the binary path via `frames.frame`)."""
+    return frames.frame(encode_body(obj))
 
 
 class FrameReader:
     """Incremental length-field frame reassembly for raw sockets (the
-    client half; servers reuse the stream Framing stages)."""
+    client half; servers reuse the stream Framing stages). `feed` yields
+    decoded JSON bodies; `feed_raw` yields raw bodies (the binary reply
+    path decodes them with frames.decode_replies)."""
 
-    def __init__(self, max_frame: int = 1 << 20):
+    def __init__(self, max_frame: int = DEFAULT_MAX_FRAME):
         self._buf = bytearray()
         self.max_frame = max_frame
 
-    def feed(self, data: bytes):
+    def feed_raw(self, data: bytes):
         self._buf.extend(data)
         while len(self._buf) >= 4:
             n = struct.unpack(">I", self._buf[:4])[0]
@@ -83,6 +122,10 @@ class FrameReader:
                 return
             body = bytes(self._buf[4:4 + n])
             del self._buf[:4 + n]
+            yield body
+
+    def feed(self, data: bytes):
+        for body in self.feed_raw(data):
             yield json.loads(body)
 
 
@@ -145,6 +188,43 @@ class RegionBackend:
                                     max_extra_steps=self.max_extra_steps)
         return float(np.asarray(reply)[0])
 
+    def ask_many(self, entity_ids: Sequence[str],
+                 values: Sequence[float]) -> List[Any]:
+        """Columnar wave ask for a decoded binary window: entity ids are
+        resolved ONCE per unique id, the whole wave rides
+        `AskBatcher.ask_many` (one coalesced flush + one shared step
+        budget, no per-call future hop) and the return is outcome-
+        aligned — a float total or the per-ask exception INSTANCE
+        (AskPoolExhausted / TimeoutError / ...), never a raise, so one
+        member's failure cannot fail its wave-mates."""
+        refs: Dict[str, Any] = {}
+        for e in entity_ids:
+            if e not in refs:
+                try:
+                    refs[e] = self.region.entity_ref(e)
+                except Exception as exc:  # noqa: BLE001 — per-entity typed
+                    refs[e] = exc
+        reqs, slots = [], []
+        out: List[Any] = [None] * len(entity_ids)
+        for i, (e, v) in enumerate(zip(entity_ids, values)):
+            r = refs[e]
+            if isinstance(r, BaseException):
+                out[i] = r
+                continue
+            reqs.append((r.shard, r.index, [float(v)]))
+            slots.append(i)
+        if reqs:
+            if self.batcher is not None:
+                replies = self.batcher.ask_many(reqs)
+            else:
+                replies = self.region.ask_many(
+                    reqs, steps=self.steps,
+                    max_extra_steps=self.max_extra_steps)
+            for i, rep in zip(slots, replies):
+                out[i] = rep if isinstance(rep, BaseException) \
+                    else float(np.asarray(rep)[0])
+        return out
+
     def close(self) -> None:
         if self.batcher is not None:
             self.batcher.close()
@@ -181,7 +261,7 @@ class GatewayServer:
 
     def __init__(self, system, backend, admission: AdmissionController,
                  slo: SloTracker, host: str = "127.0.0.1", port: int = 0,
-                 max_frame: int = 1 << 16):
+                 max_frame: int = DEFAULT_MAX_FRAME, registry=None):
         self.system = system
         self.backend = backend
         self.admission = admission
@@ -191,6 +271,15 @@ class GatewayServer:
         self.max_frame = max_frame
         self._binding = None
         self._seq = 0
+        self._registry = registry
+        self._h_decode_size = self._h_decode_ns = None
+        if registry is not None:
+            self._h_decode_size = registry.histogram(
+                "gateway_decode_batch_size",
+                "binary request records decoded per window")
+            self._h_decode_ns = registry.histogram(
+                "gateway_decode_ns_per_frame",
+                "nanoseconds of wire decode per binary request record")
 
     # ------------------------------------------------------------ transport
     def start(self) -> Tuple[str, int]:
@@ -223,6 +312,8 @@ class GatewayServer:
 
     # ------------------------------------------------------------- requests
     def handle_frame(self, frame: bytes) -> bytes:
+        if frames.is_binary(frame):
+            return self.handle_binary(frame)
         try:
             req = json.loads(frame)
             rid = req.get("id", -1)
@@ -279,6 +370,190 @@ class GatewayServer:
         return {"id": rid, "status": "shed", "reason": rej.reason,
                 "retry_after_ms": int(rej.retry_after_s * 1e3)}
 
+    # ------------------------------------------------------ binary requests
+    @staticmethod
+    def _binary_error(code: str) -> bytes:
+        """Typed malformed-binary reply (the `bad_request:` twin): one
+        error record with id -1, mirroring the JSON path's keep-serving
+        discipline."""
+        return frames.encode_reply_batch(
+            np.asarray([-1], np.int64),
+            np.asarray([frames.ST_ERROR], np.uint8),
+            np.asarray([f"bad_frame:{code}".encode("utf-8")
+                        [:frames.REASON_BYTES]]),
+            np.zeros(1), np.zeros(1, np.uint32))
+
+    def handle_binary(self, body: bytes) -> bytes:
+        """One binary window: batch decode -> columnar serve -> one
+        vectorized reply encode."""
+        rec = self._decode_window([body])
+        if isinstance(rec, bytes):  # typed decode error
+            return rec
+        cols = self._serve_records(rec)
+        return frames.encode_reply_batch(*cols)
+
+    def handle_frame_batch(self, bodies: Sequence[bytes]) -> List[bytes]:
+        """Window entry point for in-proc transports and batched load
+        generators: contiguous BINARY frames in `bodies` merge into one
+        decode pass and ONE ask wave; JSON frames are served one by one
+        (the fallback stays frame-at-a-time). Returns one reply body per
+        input frame, aligned."""
+        out: List[Optional[bytes]] = [None] * len(bodies)
+        i = 0
+        while i < len(bodies):
+            if not frames.is_binary(bodies[i]):
+                out[i] = self.handle_frame(bodies[i])
+                i += 1
+                continue
+            # accumulate the contiguous binary run [i, j)
+            j = i
+            spans: List[Tuple[int, int]] = []  # (frame index, n records)
+            recs = []
+            while j < len(bodies) and frames.is_binary(bodies[j]):
+                r = self._decode_window([bodies[j]])
+                if isinstance(r, bytes):
+                    out[j] = r  # typed decode error for THIS frame only
+                else:
+                    spans.append((j, len(r)))
+                    recs.append(r)
+                j += 1
+            if recs:
+                merged = np.concatenate(recs) if len(recs) > 1 else recs[0]
+                ids, st, rsn, val, retry = self._serve_records(merged)
+                lo = 0
+                for idx, n in spans:
+                    hi = lo + n
+                    out[idx] = frames.encode_reply_batch(
+                        ids[lo:hi], st[lo:hi], rsn[lo:hi], val[lo:hi],
+                        retry[lo:hi])
+                    lo = hi
+            i = j
+        return out  # type: ignore[return-value]
+
+    def _decode_window(self, bodies: Sequence[bytes]):
+        """Decode one or more binary bodies; returns the record array or
+        an encoded typed-error reply (bytes). Decode metrics ride the
+        registry step axis like the ask-batch stats."""
+        t0 = time.perf_counter_ns()
+        try:
+            recs = [frames.decode_request_batch(b, self.max_frame)
+                    for b in bodies]
+            rec = np.concatenate(recs) if len(recs) > 1 else recs[0]
+        except frames.FrameFormatError as e:
+            return self._binary_error(e.code)
+        if self._h_decode_size is not None:
+            dt = time.perf_counter_ns() - t0
+            step = self._registry.step
+            self._h_decode_size.observe(float(len(rec)), step=step)
+            self._h_decode_ns.observe(dt / len(rec), step=step)
+        return rec
+
+    def _serve_records(self, rec: np.ndarray):
+        """The columnar twin of the JSON request path, one whole window
+        at a time: admin/malformed checks -> vectorized per-tenant
+        admission charge -> ONE ask wave -> vectorized reply columns.
+        Check order mirrors the JSON path exactly (missing entity is
+        typed BEFORE admission and never charges the bucket; unknown op
+        is typed AFTER admission, charged, like JSON); SLO counters are
+        recorded per tenant with `record_many` — counter-identical to N
+        JSON requests."""
+        n = len(rec)
+        ids = rec["id"].astype(np.int64)
+        ops = rec["op"]
+        tenants = rec["tenant"]
+        entities = rec["entity"]
+        status = np.full((n,), frames.ST_ERROR, np.uint8)
+        reason = np.zeros((n,), f"S{frames.REASON_BYTES}")
+        value = np.zeros((n,), np.float64)
+        retry = np.zeros((n,), np.uint32)
+
+        admin = tenants == ADMIN_TENANT.encode("utf-8")
+        reason[admin] = b"bad_request:admin_requires_json"
+        missing = ~admin & (entities == b"")
+        reason[missing] = b"bad_request:missing_entity"
+        eligible = ~admin & ~missing
+
+        slo_outcomes: Dict[bytes, List[str]] = {}
+        slo_lat: Dict[bytes, List[Optional[float]]] = {}
+
+        def note(t: bytes, outcome: str, lat: Optional[float] = None,
+                 count: int = 1) -> None:
+            slo_outcomes.setdefault(t, []).extend([outcome] * count)
+            slo_lat.setdefault(t, []).extend([lat] * count)
+
+        # ---- vectorized per-tenant admission charge (one debit/tenant)
+        admitted = np.zeros((n,), bool)
+        for t in np.unique(tenants[eligible]) if eligible.any() else ():
+            rows = np.nonzero(eligible & (tenants == t))[0]
+            k, rej = self.admission.admit_batch(t.decode("utf-8"), len(rows))
+            admitted[rows[:k]] = True
+            if rej is not None:
+                shed = rows[k:]
+                status[shed] = frames.ST_SHED
+                reason[shed] = rej.reason.encode("utf-8") \
+                    [:frames.REASON_BYTES]
+                retry[shed] = int(rej.retry_after_s * 1e3)
+                note(t, "reject", count=len(shed))
+
+        # unknown-op is typed AFTER admission (the JSON path charges the
+        # bucket before it inspects the op)
+        known = np.isin(ops, (frames.OP_GET, frames.OP_ADD))
+        for i in np.nonzero(admitted & ~known)[0]:
+            reason[i] = f"unknown_op:{int(ops[i])}".encode("utf-8") \
+                [:frames.REASON_BYTES]
+            note(tenants[i], "error")
+        for i in np.nonzero(missing)[0]:
+            note(tenants[i], "error")
+
+        # ---- ONE ask wave for the whole admitted window
+        serve = np.nonzero(admitted & known)[0]
+        if len(serve):
+            vals = np.where(ops[serve] == frames.OP_ADD,
+                            rec["value"][serve].astype(np.float64), 0.0)
+            ents = [entities[i].decode("utf-8") for i in serve]
+            t0 = time.perf_counter()
+            outcomes = self._backend_ask_many(ents, vals)
+            dt = time.perf_counter() - t0
+            pool_noted = False
+            for i, outc in zip(serve, outcomes):
+                t = tenants[i]
+                if isinstance(outc, AskPoolExhausted):
+                    if not pool_noted:
+                        self.admission.note_ask_pool_exhausted()
+                        pool_noted = True
+                    status[i] = frames.ST_SHED
+                    reason[i] = b"ask_pool_exhausted"
+                    retry[i] = int(self.admission.cooldown_s * 1e3)
+                    note(t, "reject")
+                elif isinstance(outc, TimeoutError):
+                    reason[i] = b"timeout"
+                    note(t, "timeout", dt)
+                elif isinstance(outc, BaseException):
+                    reason[i] = f"fault:{type(outc).__name__}" \
+                        .encode("utf-8")[:frames.REASON_BYTES]
+                    note(t, "error", dt)
+                else:
+                    status[i] = frames.ST_OK
+                    value[i] = outc
+                    note(t, "ok", dt)
+
+        for t, outs in slo_outcomes.items():
+            self.slo.record_many(t.decode("utf-8"), outs, slo_lat[t])
+        return ids, status, reason, value, retry
+
+    def _backend_ask_many(self, entity_ids: List[str],
+                          values: np.ndarray) -> List[Any]:
+        asker = getattr(self.backend, "ask_many", None)
+        if asker is not None:
+            return asker(entity_ids, values)
+        out: List[Any] = []
+        for e, v in zip(entity_ids, values):
+            try:
+                out.append(self.backend.ask(e, float(v)))
+            except Exception as exc:  # noqa: BLE001 — per-ask outcome
+                out.append(exc)
+        return out
+
     # ---------------------------------------------------------------- admin
     def _handle_admin(self, rid, op: str, req: Dict[str, Any]) \
             -> Dict[str, Any]:
@@ -318,12 +593,6 @@ class GatewayServer:
                     "reason": f"admin_fault:{type(e).__name__}:{e}"}
 
 
-def encode_body(obj: Dict[str, Any]) -> bytes:
-    """Reply body only — the stream encoder stage (or the in-proc caller)
-    adds the length prefix."""
-    return json.dumps(obj, separators=(",", ":")).encode("utf-8")
-
-
 # ------------------------------------------------------------------- client
 class GatewayClient:
     """Blocking raw-socket client (tests / load generators / example).
@@ -331,12 +600,14 @@ class GatewayClient:
     reply dict. `request_retry` reconnects through server restarts — the
     chaos legs' client behavior."""
 
-    def __init__(self, host: str, port: int, timeout: float = 15.0):
+    def __init__(self, host: str, port: int, timeout: float = 15.0,
+                 max_frame: int = DEFAULT_MAX_FRAME):
         self.host = host
         self.port = port
         self.timeout = timeout
+        self.max_frame = max_frame
         self._sock: Optional[socket.socket] = None
-        self._reader = FrameReader()
+        self._reader = FrameReader(max_frame)
         self._seq = 0
 
     def connect(self) -> None:
@@ -345,7 +616,7 @@ class GatewayClient:
                                      timeout=self.timeout)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = s
-        self._reader = FrameReader()
+        self._reader = FrameReader(self.max_frame)
 
     def close(self) -> None:
         if self._sock is not None:
@@ -368,6 +639,38 @@ class GatewayClient:
                 raise ConnectionError("gateway closed the connection")
             for reply in self._reader.feed(data):
                 return reply
+
+    def request_many(self, requests: Sequence[Tuple[str, str, str, float]]
+                     ) -> List[Dict[str, Any]]:
+        """Binary window ask: `requests` is a sequence of
+        `(tenant, entity, op, value)`; the whole window rides ONE binary
+        frame (one batch decode + one ask wave server-side) and the
+        reply wave decodes to JSON-twin dicts, aligned with the input.
+        One window in flight per connection, like `request`."""
+        if self._sock is None:
+            self.connect()
+        ids, tenants, entities, ops, values = [], [], [], [], []
+        for tenant, entity, op, val in requests:
+            self._seq += 1
+            ids.append(self._seq)
+            tenants.append(tenant)
+            entities.append(entity)
+            ops.append(op)
+            values.append(float(val))
+        body = frames.encode_request_batch(ids, tenants, entities, ops,
+                                           values)
+        self._sock.sendall(frames.frame(body))
+        while True:
+            data = self._sock.recv(65536)
+            if not data:
+                raise ConnectionError("gateway closed the connection")
+            for reply in self._reader.feed_raw(data):
+                return frames.decode_replies(reply, self.max_frame)
+
+    def request_binary(self, tenant: str, entity: str, op: str,
+                       value: float = 0.0) -> Dict[str, Any]:
+        """Solo binary ask — the JSON `request`'s bit-identical twin."""
+        return self.request_many([(tenant, entity, op, value)])[0]
 
     def request_retry(self, tenant: str, entity: str, op: str,
                       value: float = 0.0, deadline_s: float = 60.0,

@@ -103,6 +103,35 @@ class SloTracker:
             step = self.registry.step if self.registry is not None else None
             self._hist.observe(latency_s * 1e3, step=step)
 
+    def record_many(self, tenant: str, outcomes, latencies_s=None) -> None:
+        """Wave recording for the batch-decoded ingress path: all of one
+        tenant's outcomes from a reply wave under ONE lock acquisition,
+        with the latency histogram fed in one vectorized observe.
+        `outcomes` is a sequence of outcome names; `latencies_s` (same
+        length or None) carries per-request latencies, None entries
+        skipped — counter parity with N record() calls is exact."""
+        counts: Dict[str, int] = {}
+        for o in outcomes:
+            if o not in _OUTCOMES:
+                raise ValueError(f"unknown outcome {o!r}")
+            counts[o] = counts.get(o, 0) + 1
+        if not counts:
+            return
+        lats = [s for s in (latencies_s or ()) if s is not None]
+        with self._lock:
+            per = self._per_tenant.get(tenant)
+            if per is None:
+                per = self._per_tenant[tenant] = {o: 0 for o in _OUTCOMES}
+            for o, c in counts.items():
+                self._counts[o] += c
+                per[o] += c
+            if lats:
+                self._lat_ms.extend(s * 1e3 for s in lats)
+                self._lat_seq += len(lats)
+        if self._hist is not None and lats:
+            step = self.registry.step if self.registry is not None else None
+            self._hist.observe_many([s * 1e3 for s in lats], step=step)
+
     # ---------------------------------------------------------- percentiles
     def percentile(self, q: float) -> float:
         """Nearest-rank percentile (ms) over the sliding window."""

@@ -7,10 +7,11 @@ from .serialization import (JsonSerializer, PickleSerializer,  # noqa: F401
                             StringSerializer, TensorSerializer,
                             transport_information)
 from .versioned import SchemaMigration, VersionedJsonSerializer  # noqa: F401
+from . import frames  # noqa: F401  (binary gateway frame format)
 
 __all__ = [
     "Serialization", "Serializer", "SerializationError",
     "PickleSerializer", "StringSerializer", "JsonSerializer",
     "TensorSerializer", "transport_information",
-    "SchemaMigration", "VersionedJsonSerializer",
+    "SchemaMigration", "VersionedJsonSerializer", "frames",
 ]

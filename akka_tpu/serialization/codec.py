@@ -35,6 +35,11 @@ _I64 = struct.Struct(">q")
 _F64 = struct.Struct(">d")
 _U32 = struct.Struct(">I")
 
+# public aliases: the wire-integer primitives other fixed-schema layouts
+# build on (serialization/frames.py — the gateway's binary frame format
+# shares this module's big-endian convention)
+I64, F64, U32 = _I64, _F64, _U32
+
 _TRUSTED_PREFIX = "akka_tpu."
 
 _registry_lock = threading.Lock()

@@ -276,6 +276,60 @@ class AskBatcher:
         return self.submit(shard, index, message, steps,
                            max_extra_steps).result()
 
+    def ask_many(self, requests: Sequence[Any]) -> List[Any]:
+        """Columnar wave entry (ISSUE 11): `requests` is a sequence of
+        `(shard, index, message)` decoded from one binary window.
+        Returns outcomes aligned with `requests` — the reply payload or
+        the per-ask exception INSTANCE (never raises per-ask).
+
+        A multi-request wave IS already a batch, so it skips the
+        per-call future hop and the dispatcher window entirely: the
+        caller's thread runs `execute_ask_batch` directly under the
+        region's ask lock (serialized with dispatcher batches by that
+        same lock — wave linearization per entity is unchanged). A
+        wave of one submits through the dispatcher as usual so it can
+        coalesce with concurrent single asks."""
+        reqs = list(requests)
+        if not reqs:
+            return []
+        if len(reqs) == 1:
+            s, i, m = reqs[0]
+            try:
+                return [self.ask(s, i, m)]
+            except BaseException as e:  # noqa: BLE001 — outcome convention
+                return [e]
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("AskBatcher is closed")
+        batch = [BatchAsk(int(s), int(i), m, self.steps,
+                          self.max_extra_steps) for s, i, m in reqs]
+        region = self.region
+        t0 = time.perf_counter()
+        # waves larger than the promise pool ride consecutive sub-batches
+        # (the submit path's max_batch cap, applied here without futures)
+        for lo in range(0, len(batch), self.max_batch):
+            sub = batch[lo:lo + self.max_batch]
+            try:
+                with region._ask_lock:
+                    execute_ask_batch(region, sub)
+            except BaseException as e:  # noqa: BLE001 — never half-resolve
+                for a in sub:
+                    if a.outcome is None:
+                        a.outcome = e
+            with self._lock:
+                self._batches += 1
+                self._asks += len(sub)
+                self._max_seen = max(self._max_seen, len(sub))
+                if len(sub) > 1:
+                    self._multi += 1
+            if self._h_size is not None:
+                self._h_size.observe(float(len(sub)))
+            if self._h_wait is not None:
+                # columnar waves never wait for a window to close: the
+                # whole wave arrived at once, so its wait is dispatch lag
+                self._h_wait.observe((time.perf_counter() - t0) * 1e6)
+        return [a.outcome for a in batch]
+
     # ---------------------------------------------------------- dispatcher
     def _loop(self) -> None:
         while True:

@@ -1224,6 +1224,220 @@ def bench_gateway_concurrency(region, per_leg: int = 192):
                 b64["batch"]["mean_batch_size"], 2)}
 
 
+def bench_gateway_binary_ab(region, per_leg: int = 384, window: int = 16):
+    """64-client ingress-encoding A/B (ISSUE 11 acceptance): the SAME
+    request mix through handle_frame as individual JSON frames vs binary
+    `window`-record frames, equal admission (wide open, both legs admit
+    everything) on one shared region. The binary leg rides batch decode
+    -> vectorized per-tenant admission -> ONE ask wave per window; the
+    acceptance bar is binary >= 2x JSON req/s."""
+    import threading as _threading
+
+    from akka_tpu.gateway import (AdmissionController, GatewayServer,
+                                  RegionBackend, SloTracker)
+    from akka_tpu.serialization import frames as _frames
+
+    clients = 64
+    per_client = max(window, per_leg // clients)
+    per_client -= per_client % window  # whole windows: legs serve equal n
+
+    def leg(binary: bool):
+        backend = RegionBackend(region, max_batch=64)
+        slo = SloTracker(target_p50_ms=50.0, target_p99_ms=250.0)
+        adm = AdmissionController(rate=1e9, burst=1e9)
+        srv = GatewayServer(None, backend, adm, slo)
+        not_ok = []
+
+        def worker(w: int):
+            # 16 consecutive ids mod 48 are distinct: every window fans
+            # out to `window` different entities (one ask wave), and both
+            # legs contend on the same 48-entity set
+            reqs = [(f"t{w % 4}", f"ab-{(w * window + i) % 48}",
+                     "add" if i % 4 else "get", float(i % 5 + 1))
+                    for i in range(per_client)]
+            if binary:
+                for lo in range(0, per_client, window):
+                    chunk = reqs[lo:lo + window]
+                    body = _frames.encode_request_batch(
+                        list(range(lo, lo + len(chunk))),
+                        [r[0] for r in chunk], [r[1] for r in chunk],
+                        [r[2] for r in chunk], [r[3] for r in chunk])
+                    for rep in _frames.decode_replies(
+                            srv.handle_frame(body)):
+                        if rep["status"] != "ok":
+                            not_ok.append(rep["status"])
+            else:
+                for i, (t, e, op, v) in enumerate(reqs):
+                    rep = json.loads(srv.handle_frame(json.dumps(
+                        {"id": i, "tenant": t, "entity": e, "op": op,
+                         "value": v}).encode()))
+                    if rep["status"] != "ok":
+                        not_ok.append(rep["status"])
+
+        threads = [_threading.Thread(target=worker, args=(w,))
+                   for w in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        n = per_client * clients
+        art = slo.artifact()
+        backend.close()
+        row = {"encoding": "binary" if binary else "json",
+               "clients": clients, "window": window if binary else 1,
+               "requests": n, "wall_s": round(dt, 3),
+               "req_per_sec": round(n / dt, 1), "not_ok": len(not_ok),
+               "admitted": adm.admitted, "rejected": adm.rejected,
+               "p50_ms": art["p50_ms"], "p99_ms": art["p99_ms"]}
+        try:
+            row["host_loadavg"] = round(os.getloadavg()[0], 2)
+        except OSError:
+            pass
+        return row
+
+    j, b = leg(False), leg(True)
+    speedup = round(b["req_per_sec"] / max(j["req_per_sec"], 1e-9), 2)
+    return {"json": j, "binary": b, "speedup": speedup,
+            "equal_admission": (j["admitted"] == b["admitted"]
+                                and j["rejected"] == b["rejected"] == 0),
+            "ok": speedup >= 2.0}
+
+
+def bench_ingest_decode(n_requests: int = 8192, window: int = 64,
+                        per_leg: int = 768):
+    """ingest-decode (ISSUE 11): how fast wire bytes become served
+    requests, JSON vs binary A/B, two layers:
+
+    - decode_only: pure wire decode, no backend — binary windows through
+      `frames.decode_request_batch` (one np.frombuffer per window) vs the
+      same requests through per-frame json.loads. The tier-1 smoke pins
+      the binary side >= 3x; this is the full-size number.
+    - sweep: 1 / 8 / 64 client threads driving the FULL handle_frame
+      path (admission + SLO + region ask) on one shared region — binary
+      clients send `window`-record frames, JSON clients the same
+      requests frame-at-a-time. Rows are host-stamped and carry
+      decoded-frames/s; binary rows add the gateway_decode_* histogram
+      snapshots (the MetricsRegistry satellites)."""
+    import threading as _threading
+
+    import jax
+
+    from akka_tpu.event.metrics import MetricsRegistry
+    from akka_tpu.gateway import (AdmissionController, GatewayServer,
+                                  RegionBackend, SloTracker,
+                                  counter_behavior)
+    from akka_tpu.serialization import frames as _frames
+    from akka_tpu.sharding.device import DeviceEntity, DeviceShardRegion
+
+    # ---- decode-only A/B
+    def mk_reqs(n):
+        return [(i, f"t{i % 8}", f"acct-{i % 48}",
+                 "add" if i % 4 else "get", float(i % 5 + 1))
+                for i in range(n)]
+
+    reqs = mk_reqs(n_requests)
+    bin_bodies = [
+        _frames.encode_request_batch(
+            [r[0] for r in chunk], [r[1] for r in chunk],
+            [r[2] for r in chunk], [r[3] for r in chunk],
+            [r[4] for r in chunk])
+        for chunk in (reqs[lo:lo + window]
+                      for lo in range(0, n_requests, window))]
+    json_bodies = [json.dumps({"id": i, "tenant": t, "entity": e, "op": op,
+                               "value": v}).encode()
+                   for i, t, e, op, v in reqs]
+
+    def timed(f, reps=3):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            f()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    tb = timed(lambda: [_frames.decode_request_batch(b) for b in bin_bodies])
+    tj = timed(lambda: [json.loads(b) for b in json_bodies])
+    decode_only = {
+        "requests": n_requests, "window": window,
+        "binary_frames_per_sec": round(n_requests / tb, 0),
+        "json_frames_per_sec": round(n_requests / tj, 0),
+        "binary_ns_per_frame": round(tb / n_requests * 1e9, 1),
+        "json_ns_per_frame": round(tj / n_requests * 1e9, 1),
+        "speedup": round(tj / tb, 1)}
+
+    # ---- full-path sweep on a real region
+    spec = DeviceEntity("bench_dec", counter_behavior(4), n_shards=4,
+                        entities_per_shard=64,
+                        n_devices=min(2, len(jax.devices())),
+                        payload_width=4)
+    region = DeviceShardRegion(spec)
+
+    def leg(clients: int, binary: bool):
+        reg = MetricsRegistry()
+        backend = RegionBackend(region, max_batch=64, registry=reg)
+        slo = SloTracker(registry=reg)
+        adm = AdmissionController(rate=1e9, burst=1e9)
+        srv = GatewayServer(None, backend, adm, slo, registry=reg)
+        per_client = max(window, per_leg // clients)
+        per_client -= per_client % window
+
+        def worker(w: int):
+            wreqs = mk_reqs(per_client)
+            if binary:
+                for lo in range(0, per_client, window):
+                    chunk = wreqs[lo:lo + window]
+                    srv.handle_frame(_frames.encode_request_batch(
+                        [r[0] for r in chunk], [r[1] for r in chunk],
+                        [r[2] for r in chunk], [r[3] for r in chunk],
+                        [r[4] for r in chunk]))
+            else:
+                for i, t, e, op, v in wreqs:
+                    srv.handle_frame(json.dumps(
+                        {"id": i, "tenant": t, "entity": e, "op": op,
+                         "value": v}).encode())
+
+        threads = [_threading.Thread(target=worker, args=(w,))
+                   for w in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        n = per_client * clients
+        art = slo.artifact()
+        backend.close()
+        row = {"clients": clients,
+               "encoding": "binary" if binary else "json",
+               "requests": n, "wall_s": round(dt, 3),
+               "req_per_sec": round(n / dt, 1),
+               "ok": art["ok"], "p50_ms": art["p50_ms"],
+               "p99_ms": art["p99_ms"]}
+        if binary:
+            row["decode_batch_size"] = \
+                reg.histogram("gateway_decode_batch_size").snapshot()
+            row["decode_ns_per_frame"] = \
+                reg.histogram("gateway_decode_ns_per_frame").snapshot()
+        try:
+            row["host_loadavg"] = round(os.getloadavg()[0], 2)
+        except OSError:
+            pass
+        return row
+
+    sweep = [leg(c, binary) for c in (1, 8, 64)
+             for binary in (False, True)]
+
+    def rps(clients, enc):
+        return next(r["req_per_sec"] for r in sweep
+                    if r["clients"] == clients and r["encoding"] == enc)
+
+    return {"decode_only": decode_only, "sweep": sweep,
+            "speedup_64": round(rps(64, "binary") /
+                                max(rps(64, "json"), 1e-9), 2)}
+
+
 def bench_gateway_slo(n_requests: int = 400, n_entities: int = 16):
     """gateway-slo: sustained request load through the serving gateway's
     in-proc ingress path (handle_frame -> admission -> region ask), two
@@ -1281,10 +1495,12 @@ def bench_gateway_slo(n_requests: int = 400, n_entities: int = 16):
     total = backend.sum_all()
     backend.close()
     concurrency = bench_gateway_concurrency(region)
+    binary_ab = bench_gateway_binary_ab(region, per_leg=n_requests)
     return {"below_threshold": below, "overload": over,
             "entities_total": round(total, 1),
             "shed_working": over["rejects"] > 0 and below["rejects"] == 0,
-            "concurrency": concurrency}
+            "concurrency": concurrency,
+            "binary_ab": binary_ab}
 
 
 def main() -> None:
@@ -1301,7 +1517,7 @@ def main() -> None:
                                          "supervision", "checkpoint-overhead",
                                          "metrics-overhead",
                                          "failover-mttr", "reshard-pause",
-                                         "gateway-slo",
+                                         "gateway-slo", "ingest-decode",
                                          "spawn", "stream"],
                     help="run a single config (spawn/stream are extra "
                          "JMH-analogue microbenches outside the default "
@@ -1600,10 +1816,13 @@ def main() -> None:
                 gw_n = 120 if args.smoke else 400
                 out = bench_gateway_slo(gw_n)
                 b, o = out["below_threshold"], out["overload"]
+                ab = out["binary_ab"]
                 print(f"[bench] gateway-slo: p50={b['p50_ms']}ms "
                       f"p99={b['p99_ms']}ms @{b['req_per_sec']}req/s | "
                       f"overload reject_rate={o['reject_rate']} "
-                      f"shed={'OK' if out['shed_working'] else 'FAIL'}",
+                      f"shed={'OK' if out['shed_working'] else 'FAIL'} | "
+                      f"binary x{ab['speedup']} "
+                      f"{'OK' if ab['ok'] else 'FAIL'}",
                       file=sys.stderr)
                 print(json.dumps({
                     "metric": "gateway serving latency p99, sustained load "
@@ -1612,6 +1831,24 @@ def main() -> None:
                     "value": b["p99_ms"], "unit": "ms",
                     "vs_baseline": 1.0,
                     "extra": {"gateway": out, **extra}}))
+            elif args.config == "ingest-decode":
+                dec_n = 2048 if args.smoke else 8192
+                dec_leg = 192 if args.smoke else 768
+                out = bench_ingest_decode(dec_n, per_leg=dec_leg)
+                d = out["decode_only"]
+                print(f"[bench] ingest-decode: binary "
+                      f"{d['binary_ns_per_frame']}ns/frame vs json "
+                      f"{d['json_ns_per_frame']}ns/frame "
+                      f"(x{d['speedup']} decode) | full path 64-client "
+                      f"x{out['speedup_64']}", file=sys.stderr)
+                print(json.dumps({
+                    "metric": "binary ingress decode throughput "
+                              "(frames/s, batch np.frombuffer)"
+                              + scale_tag,
+                    "value": d["binary_frames_per_sec"],
+                    "unit": "frames/sec",
+                    "vs_baseline": d["speedup"],
+                    "extra": {"ingest_decode": out, **extra}}))
             elif args.config == "modes":
                 out = bench_modes(n, mode_steps)
                 best = max(r["msgs_per_sec"] for r in out.values()

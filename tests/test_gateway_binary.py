@@ -1,0 +1,399 @@
+"""Binary ingress (serialization/frames.py + gateway/ingress.py, ISSUE 11):
+fixed-schema frame codec, batch decode, the columnar serve path, and the
+equivalence contract against the JSON fallback.
+
+Tier-1 scope: everything here is hostside or rides the module-scoped
+region (the same spec shape as test_gateway's, so the in-process jit
+cache is already warm); shapes stay <= 64 rows (the pow2-floor-64 scatter
+padding means no new XLA compiles)."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from akka_tpu.gateway import (AdmissionController, GatewayServer,
+                              RegionBackend, SloTracker, counter_behavior)
+from akka_tpu.gateway.ingress import DEFAULT_MAX_FRAME, encode_body
+from akka_tpu.serialization import frames
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(scope="module")
+def small_region():
+    from akka_tpu.sharding.device import DeviceEntity, DeviceShardRegion
+    spec = DeviceEntity("gwb", counter_behavior(4), n_shards=2,
+                        entities_per_shard=8, n_devices=2, payload_width=4)
+    return DeviceShardRegion(spec)
+
+
+def _server(backend, rate=1e6, burst=1e6, clock=None, registry=None):
+    adm = AdmissionController(rate=rate, burst=burst,
+                              **({"clock": clock} if clock else {}))
+    return GatewayServer(None, backend, adm, SloTracker(registry=registry),
+                         registry=registry)
+
+
+# ----------------------------------------------------------------- frame codec
+def test_request_batch_roundtrip():
+    body = frames.encode_request_batch(
+        ids=[1, 2, 3], tenants=["t0", "t1", "t0"],
+        entities=["a", "bb", "ccc"], ops=["add", "get", 1],
+        values=[1.5, 0.0, -2.25])
+    rec = frames.decode_request_batch(body)
+    assert len(rec) == 3
+    assert rec["id"].tolist() == [1, 2, 3]
+    assert rec["op"].tolist() == [frames.OP_ADD, frames.OP_GET, frames.OP_ADD]
+    assert rec["tenant"].tolist() == [b"t0", b"t1", b"t0"]
+    assert rec["entity"].tolist() == [b"a", b"bb", b"ccc"]
+    assert rec["value"].tolist() == [1.5, 0.0, -2.25]
+
+
+def test_reply_batch_roundtrip_and_json_twin_dicts():
+    body = frames.encode_reply_batch(
+        np.asarray([7, 8, -1], np.int64),
+        np.asarray([frames.ST_OK, frames.ST_SHED, frames.ST_ERROR], np.uint8),
+        np.asarray([b"", b"rate_limited", b"timeout"]),
+        np.asarray([42.5, 0.0, 0.0]),
+        np.asarray([0, 120, 0], np.uint32))
+    ok, shed, err = frames.decode_replies(body)
+    # key sets per status match the JSON protocol exactly
+    assert ok == {"id": 7, "status": "ok", "value": 42.5}
+    assert shed == {"id": 8, "status": "shed", "reason": "rate_limited",
+                    "retry_after_ms": 120}
+    assert err == {"id": -1, "status": "error", "reason": "timeout"}
+
+
+def test_frame_sniffing_disjoint_first_bytes():
+    bin_body = frames.encode_request_batch([1], ["t"], ["e"], ["get"], [0.0])
+    json_body = encode_body({"id": 1, "tenant": "t", "entity": "e",
+                             "op": "get"})
+    assert frames.is_binary(bin_body)
+    assert not frames.is_binary(json_body)
+    assert bin_body[0] == 0xAB and json_body[0] == ord("{")
+
+
+def test_malformed_frames_typed_codes():
+    good = frames.encode_request_batch([1], ["t"], ["e"], ["get"], [0.0])
+
+    def code_of(body, **kw):
+        with pytest.raises(frames.FrameFormatError) as ei:
+            frames.decode_request_batch(body, **kw)
+        return ei.value.code
+
+    assert code_of(b"\xab\x01") == "truncated_header"
+    assert code_of(b"\xff" + good[1:]) == "bad_magic"
+    assert code_of(bytes([0xAB, 99]) + good[2:]) == "unsupported_version"
+    assert code_of(good[:-1]) == "bad_length"
+    assert code_of(good + b"x") == "bad_length"
+    assert code_of(good, max_frame=8) == "oversize"
+    # count=0 with a consistent length is still refused
+    empty = frames._header(frames.KIND_REQUEST, 0)
+    assert code_of(empty) == "empty_batch"
+    # a reply body fed to the request decoder is typed, not mis-decoded
+    reply = frames.encode_reply_batch(
+        np.asarray([1], np.int64), np.asarray([0], np.uint8),
+        np.asarray([b""]), np.zeros(1), np.zeros(1, np.uint32))
+    assert code_of(reply) == "wrong_kind"
+
+
+def test_string_too_long_is_typed_at_encode_time():
+    with pytest.raises(frames.FrameFormatError, match="tenant_too_long"):
+        frames.encode_request_batch([1], ["t" * 17], ["e"], ["get"], [0.0])
+    with pytest.raises(frames.FrameFormatError, match="entity_too_long"):
+        frames.encode_request_batch([1], ["t"], ["e" * 25], ["get"], [0.0])
+
+
+def test_server_malformed_binary_replies_typed_and_keeps_serving():
+    """Every malformed-binary shape surfaces as a one-record
+    bad_frame:<code> reply (the JSON path's bad_request twin) and the
+    server keeps serving afterwards — no admission charge, no SLO count,
+    backend untouched."""
+    class NeverBackend:
+        def ask(self, entity_id, value):
+            raise AssertionError("backend must not see a malformed frame")
+
+    srv = _server(NeverBackend())
+    good = frames.encode_request_batch([1], ["t"], ["e"], ["get"], [0.0])
+    for body, code in [(b"\xab\x01", "truncated_header"),
+                       (bytes([0xAB, 99]) + good[2:], "unsupported_version"),
+                       (good[:-1], "bad_length"),
+                       (frames._header(frames.KIND_REQUEST, 0),
+                        "empty_batch")]:
+        rep = frames.decode_replies(srv.handle_frame(body))
+        assert rep == [{"id": -1, "status": "error",
+                        "reason": f"bad_frame:{code}"}]
+    assert srv.admission.admitted == 0
+    assert srv.slo.artifact()["requests"] == 0
+    # still serving: a well-formed frame after the garbage works
+    class OkBackend:
+        def ask(self, entity_id, value):
+            return 5.0
+    srv.backend = OkBackend()
+    rep = frames.decode_replies(srv.handle_frame(good))
+    assert rep == [{"id": 1, "status": "ok", "value": 5.0}]
+
+
+def test_binary_admin_is_typed_error():
+    srv = _server(None)
+    body = frames.encode_request_batch([1], ["__admin"], ["e"], ["get"],
+                                       [0.0])
+    rep = frames.decode_replies(srv.handle_frame(body))[0]
+    assert rep["status"] == "error"
+    assert rep["reason"] == "bad_request:admin_requires_json"
+    assert srv.slo.artifact()["requests"] == 0  # admin bypasses SLO, like JSON
+
+
+# ------------------------------------------------------- frame-size unification
+def test_one_frame_limit_at_both_ends():
+    """Satellite: the client's FrameReader and the server's framing used
+    to disagree (1<<20 vs 1<<16); now ONE default is shared by frames,
+    ingress, FrameReader, GatewayServer and GatewayClient."""
+    from akka_tpu.gateway.ingress import FrameReader, GatewayClient
+    assert DEFAULT_MAX_FRAME == frames.DEFAULT_MAX_FRAME == 1 << 20
+    assert FrameReader().max_frame == DEFAULT_MAX_FRAME
+    assert GatewayServer(None, None, None, None).max_frame \
+        == DEFAULT_MAX_FRAME
+    assert GatewayClient("h", 1).max_frame == DEFAULT_MAX_FRAME
+    # a frame above the OLD client limit (1<<16) now reassembles fine
+    big = {"id": 1, "status": "ok", "value": "x" * (1 << 17)}
+    blob = frames.frame(encode_body(big))
+    out = list(FrameReader().feed(blob))
+    assert out == [big]
+
+
+# ------------------------------------------------------------ vectorized parity
+def test_acquire_upto_matches_sequential_try_acquire():
+    from akka_tpu.gateway import TokenBucket
+    for rate, burst, taken, n in [(10.0, 3.0, 0, 5), (10.0, 3.0, 2, 5),
+                                  (0.0, 4.0, 0, 2), (5.0, 2.5, 0, 3)]:
+        ca, cb = FakeClock(), FakeClock()
+        a = TokenBucket(rate=rate, burst=burst, clock=ca)
+        b = TokenBucket(rate=rate, burst=burst, clock=cb)
+        for _ in range(taken):
+            a.try_acquire(), b.try_acquire()
+        ca.advance(0.05), cb.advance(0.05)
+        k = a.acquire_upto(n)
+        seq = sum(b.try_acquire() for _ in range(n))
+        assert k == seq, (rate, burst, taken, n)
+
+
+def test_admit_batch_matches_sequential_admits():
+    clk1, clk2 = FakeClock(), FakeClock()
+    a1 = AdmissionController(rate=0.0, burst=3.0, clock=clk1)
+    a2 = AdmissionController(rate=0.0, burst=3.0, clock=clk2)
+    k, rej = a1.admit_batch("t0", 5)
+    assert k == 3 and rej is not None and rej.reason == "rate_limited"
+    assert rej.retry_after_s > 0
+    seq = [a2.admit("t0") for _ in range(5)]
+    assert sum(r is None for r in seq) == k
+    assert a1.admitted == a2.admitted == 3
+    assert a1.rejected_by_reason == a2.rejected_by_reason \
+        == {"rate_limited": 2}
+    # overload sheds the whole window with the typed overloaded reason
+    sig = {"v": 2.0}
+    a3 = AdmissionController(rate=1e9, burst=1e9,
+                             pressure_signals={"boom": lambda: sig["v"]},
+                             thresholds={"boom": 1.0},
+                             check_interval_s=0.0, clock=FakeClock())
+    k, rej = a3.admit_batch("t0", 4)
+    assert k == 0 and rej.reason == "overloaded:boom"
+    assert a3.rejected_by_reason == {"overloaded:boom": 4}
+
+
+def test_histogram_observe_many_matches_scalar_observe():
+    from akka_tpu.event.metrics import Histogram
+    vals = [0.0, 0.3, 1.0, 1.7, 2.0, 3.9, 4.0, 100.0, 1e6, 1e12]
+    a, b = Histogram("a"), Histogram("b")
+    a.observe_many(vals, step=7)
+    for v in vals:
+        b.observe(v, step=7)
+    assert a._buckets.tolist() == b._buckets.tolist()
+    assert a.snapshot() == b.snapshot()
+
+
+def test_slo_record_many_matches_scalar_record():
+    a, b = SloTracker(), SloTracker()
+    outs = ["ok", "ok", "reject", "timeout", "error", "ok"]
+    lats = [0.01, 0.02, None, 5.0, 0.03, 0.04]
+    a.record_many("t0", outs, lats)
+    for o, s in zip(outs, lats):
+        b.record("t0", o, s)
+    assert a.artifact() == b.artifact()
+    with pytest.raises(ValueError):
+        a.record_many("t0", ["dropped"])
+
+
+# -------------------------------------------------------- JSON <-> binary twins
+def _json_req(srv, rid, tenant, entity, op, value):
+    req = {"id": rid, "tenant": tenant, "op": op, "value": value}
+    if entity is not None:
+        req["entity"] = entity
+    return json.loads(srv.handle_frame(encode_body(req)))
+
+
+def _bin_req(srv, rid, tenant, entity, op, value):
+    body = frames.encode_request_batch(
+        [rid], [tenant], ["" if entity is None else entity],
+        [op if isinstance(op, int) else frames.OP_CODES.get(op, op)],
+        [value])
+    return frames.decode_replies(srv.handle_frame(body))[0]
+
+
+def _strip_latency(art):
+    for k in ("p50_ms", "p99_ms", "p50_met", "p99_met"):
+        art.pop(k)
+    return art
+
+
+def test_binary_json_equivalence_property(small_region):
+    """THE equivalence contract: the same mixed request sequence through
+    two fresh servers — one JSON, one binary — produces identical decoded
+    reply dicts, identical SLO counters and identical admission counters.
+    Sequence covers ok adds/gets, missing entity (typed before admission),
+    unknown op (typed after admission, charged) and rate-limit sheds."""
+    mk = lambda: _server(RegionBackend(small_region), rate=0.0, burst=6.0,
+                         clock=FakeClock())
+    srv_j, srv_b = mk(), mk()
+    # entity namespaces disjoint so both sides start from zero totals
+    seq = [("t0", "{}-a", "add", 1.5), ("t0", "{}-a", "add", 2.0),
+           ("t0", None, "add", 9.0),          # missing entity: not charged
+           ("t0", "{}-b", "add", 4.0), ("t1", "{}-a", "get", 0.0),
+           ("t0", "{}-a", 7, 0.0),            # unknown op: charged
+           ("t0", "{}-a", "get", 0.0), ("t0", "{}-b", "get", 0.0),
+           ("t0", "{}-a", "add", 1.0),        # bucket empty -> shed
+           ("t1", "{}-a", "add", 3.0)]
+    reps_j = [_json_req(srv_j, i, t, e and e.format("eqj"),
+                        "7" if op == 7 else op, v)
+              for i, (t, e, op, v) in enumerate(seq)]
+    reps_b = [_bin_req(srv_b, i, t, e and e.format("eqb"), op, v)
+              for i, (t, e, op, v) in enumerate(seq)]
+    assert reps_j == reps_b
+    assert [r["status"] for r in reps_j] == \
+        ["ok", "ok", "error", "ok", "ok", "error", "ok", "ok", "shed", "ok"]
+    assert _strip_latency(srv_j.slo.artifact()) == \
+        _strip_latency(srv_b.slo.artifact())
+    for a in (srv_j.admission, srv_b.admission):
+        # t0: 7 charges (unknown-op charged, missing-entity NOT) vs
+        # burst 6 -> 6 admitted + 1 shed; t1: 2 admitted
+        assert a.admitted == 8
+        assert a.rejected_by_reason == {"rate_limited": 1}
+    # and the windowed form of the same sequence lands the same counters
+    srv_w = mk()
+    body = frames.encode_request_batch(
+        list(range(len(seq))), [t for t, *_ in seq],
+        [(e and e.format("eqw")) or "" for _, e, *_ in seq],
+        [op if isinstance(op, int) else frames.OP_CODES.get(op, op)
+         for *_, op, _ in seq],
+        [v for *_, v in seq])
+    reps_w = frames.decode_replies(srv_w.handle_frame(body))
+    assert reps_w == reps_j
+    assert _strip_latency(srv_w.slo.artifact()) == \
+        _strip_latency(srv_j.slo.artifact())
+    assert srv_w.admission.admitted == 8
+    assert srv_w.admission.rejected_by_reason == {"rate_limited": 1}
+
+
+def test_solo_binary_is_json_twin(small_region):
+    srv = _server(RegionBackend(small_region))
+    j = _json_req(srv, 1, "tw", "twin-j", "add", 2.5)
+    b = _bin_req(srv, 1, "tw", "twin-b", "add", 2.5)
+    assert j == b == {"id": 1, "status": "ok", "value": 2.5}
+    assert _json_req(srv, 2, "tw", "twin-j", "get", 0.0)["value"] == \
+        _bin_req(srv, 2, "tw", "twin-b", "get", 0.0)["value"] == 2.5
+
+
+def test_window_linearizes_same_entity_adds(small_region):
+    """Two adds to ONE entity inside one window serialize (the ask-wave
+    one-in-flight-per-row rule): replies are the running totals and the
+    final get observes both."""
+    srv = _server(RegionBackend(small_region))
+    body = frames.encode_request_batch(
+        [1, 2, 3], ["t0"] * 3, ["lin-a"] * 3,
+        [frames.OP_ADD, frames.OP_ADD, frames.OP_GET], [1.0, 2.0, 0.0])
+    reps = frames.decode_replies(srv.handle_frame(body))
+    assert [r["value"] for r in reps] == [1.0, 3.0, 3.0]
+
+
+def test_handle_frame_batch_merges_and_aligns(small_region):
+    """In-proc window entry point: contiguous binary frames merge into
+    one decode + one wave; JSON frames and per-frame decode errors stay
+    isolated; replies align 1:1 with the inputs."""
+    srv = _server(RegionBackend(small_region))
+    b1 = frames.encode_request_batch([1, 2], ["t0"] * 2, ["hfb-a", "hfb-b"],
+                                     [frames.OP_ADD] * 2, [1.0, 2.0])
+    b2 = frames.encode_request_batch([3], ["t0"], ["hfb-a"],
+                                     [frames.OP_GET], [0.0])
+    js = encode_body({"id": 4, "tenant": "t0", "entity": "hfb-b",
+                      "op": "get"})
+    bad = b"\xab\x01"
+    outs = srv.handle_frame_batch([b1, bad, b2, js])
+    r1 = frames.decode_replies(outs[0])
+    assert [r["value"] for r in r1] == [1.0, 2.0]
+    assert frames.decode_replies(outs[1])[0]["reason"] == \
+        "bad_frame:truncated_header"
+    assert frames.decode_replies(outs[2])[0]["value"] == 1.0
+    assert json.loads(outs[3]) == {"id": 4, "status": "ok", "value": 2.0}
+
+
+# -------------------------------------------------------------- decode metrics
+def test_decode_metrics_histograms_step_stamped():
+    from akka_tpu.event.metrics import MetricsRegistry
+
+    class OkBackend:
+        def ask(self, entity_id, value):
+            return 1.0
+
+    reg = MetricsRegistry()
+    reg.set_step(42)
+    srv = _server(OkBackend(), registry=reg)
+    body = frames.encode_request_batch(
+        list(range(5)), ["t0"] * 5, [f"m-{i}" for i in range(5)],
+        [frames.OP_ADD] * 5, [1.0] * 5)
+    srv.handle_frame(body)
+    size = reg.histogram("gateway_decode_batch_size").snapshot()
+    ns = reg.histogram("gateway_decode_ns_per_frame").snapshot()
+    assert size["count"] == 1 and size["sum"] == 5.0 and size["step"] == 42
+    assert ns["count"] == 1 and ns["sum"] > 0 and ns["step"] == 42
+
+
+# -------------------------------------------------------- decode throughput
+def test_binary_batch_decode_beats_json_decode_3x():
+    """Tier-1 smoke budget (ISSUE 11 acceptance): batch-decoding a binary
+    window is >= 3x faster than json.loads over the same requests. Small
+    fixed shape (512 records), best-of-5 to dodge scheduler noise."""
+    n = 512
+    bin_body = frames.encode_request_batch(
+        list(range(n)), [f"t{i % 8}" for i in range(n)],
+        [f"acct-{i % 64}" for i in range(n)],
+        [frames.OP_ADD] * n, [float(i) for i in range(n)])
+    json_bodies = [encode_body({"id": i, "tenant": f"t{i % 8}",
+                                "entity": f"acct-{i % 64}", "op": "add",
+                                "value": float(i)}) for i in range(n)]
+
+    def best_of(f, reps=5):
+        t = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            f()
+            t.append(time.perf_counter() - t0)
+        return min(t)
+
+    tb = best_of(lambda: frames.decode_request_batch(bin_body))
+    tj = best_of(lambda: [json.loads(b) for b in json_bodies])
+    rec = frames.decode_request_batch(bin_body)
+    assert len(rec) == n
+    assert tj / tb >= 3.0, f"binary {tb * 1e6:.1f}us vs json {tj * 1e6:.1f}us"

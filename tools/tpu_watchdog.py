@@ -425,6 +425,52 @@ def on_tpu_found(detail: str) -> None:
                             "batched64_req_per_sec":
                                 b64.get("req_per_sec"),
                             "batched64_p99_ms": b64.get("p99_ms")})
+            ab = gw.get("binary_ab", {})
+            if ab:
+                # binary-ingress encoding A/B (ISSUE 11): same mix, same
+                # admission, JSON frames vs binary windows at 64 clients;
+                # acceptance is binary >= 2x JSON req/s
+                append_log({"ts": _utcnow(),
+                            "ok": bool(ab.get("ok"))
+                                  and bool(ab.get("equal_admission")),
+                            "detail": "gateway binary-ingress A/B "
+                                      "(64 clients, equal admission)",
+                            "binary_speedup": ab.get("speedup"),
+                            "binary_req_per_sec":
+                                ab.get("binary", {}).get("req_per_sec"),
+                            "json_req_per_sec":
+                                ab.get("json", {}).get("req_per_sec"),
+                            "binary_p99_ms":
+                                ab.get("binary", {}).get("p99_ms")})
+    # wire-decode throughput: batch np.frombuffer vs json.loads, plus the
+    # full-path 1/8/64-client encoding sweep (docs/SERVING_GATEWAY.md
+    # wire-protocol section)
+    run_logged("ingest", [sys.executable, "bench.py", "--config",
+                          "ingest-decode", "--probe-timeout", "120"],
+               timeout_s=1800)
+    in_out = os.path.join(REPO, "watchdog_ingest.out")
+    if os.path.exists(in_out):
+        ij = None
+        for line in open(in_out):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    ij = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
+        dec = (ij or {}).get("extra", {}).get("ingest_decode", {})
+        if dec:
+            d = dec.get("decode_only", {})
+            append_log({"ts": _utcnow(),
+                        "ok": d.get("speedup", 0) >= 3.0,
+                        "detail": "binary wire-decode throughput "
+                                  "(batch frombuffer vs json.loads)",
+                        "binary_frames_per_sec":
+                            d.get("binary_frames_per_sec"),
+                        "json_frames_per_sec":
+                            d.get("json_frames_per_sec"),
+                        "decode_speedup": d.get("speedup"),
+                        "fullpath_speedup_64": dec.get("speedup_64")})
     # elastic mesh on-chip: chained live re-shards (2->4->8->4) with the
     # scale-out pause measured against a cold restore of the SAME
     # snapshot (docs/ELASTIC_MESH.md budgets pause <= 2x restore) plus
@@ -465,7 +511,8 @@ def on_tpu_found(detail: str) -> None:
              "watchdog_trace.out", "watchdog_supervision.out",
              "watchdog_bridge.out", "watchdog_checkpoint.out",
              "watchdog_metrics.out", "watchdog_failover.out",
-             "watchdog_gateway.out", "watchdog_reshard.out"]
+             "watchdog_gateway.out", "watchdog_ingest.out",
+             "watchdog_reshard.out"]
     if last is not None:
         paths.append("BENCH_TPU.json")
     if os.path.isdir(os.path.join(REPO, "traces/tpu_r05")):
