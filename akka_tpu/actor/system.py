@@ -146,6 +146,17 @@ class ActorSystem:
         from ..event.metrics import from_config as _metrics_from_config
         self.metrics_registry = _metrics_from_config(cfg)
 
+        # causal tracing: sampled request->wave->step spans (event/
+        # tracing.py) — None unless akka.tracing.enabled; the gateway
+        # picks it up from the system and threads it through the serving
+        # path (docs/OBSERVABILITY.md tracing section)
+        from ..event.tracing import from_config as _tracer_from_config
+        self.tracer = _tracer_from_config(cfg)
+        if self.tracer is not None and self.metrics_registry is not None \
+                and self.tracer.step_fn is None:
+            # default step source: the registry's shared ATT_STEP axis
+            self.tracer.step_fn = lambda: self.metrics_registry.step
+
         # multi-host data plane: opt-in jax.distributed bootstrap (DCN) so
         # device meshes span every process in the cluster (SURVEY.md §2.3
         # TPU-native equivalent; akka.jax-distributed.* config)
@@ -284,6 +295,8 @@ class ActorSystem:
         self.flight_recorder.close()
         if self.metrics_registry is not None:
             self.metrics_registry.close()
+        if self.tracer is not None:
+            self.tracer.close()
         self._terminated.set()
         for cb in self._termination_callbacks:
             try:

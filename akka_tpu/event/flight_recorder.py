@@ -177,7 +177,11 @@ class InMemoryFlightRecorder(FlightRecorder):
         self._lock = threading.Lock()
 
     def _record(self, name: str, args, kwargs=None) -> None:
-        ev = {"event": name, "ts": time.time()}
+        # dual timestamps (ISSUE 12 satellite 2): wall `ts` for humans,
+        # monotonic `ts_mono` so tools/trace_export.py can align FR rows
+        # with tracing spans without guessing a clock offset. Rows written
+        # before this change carry `ts` only and still parse everywhere.
+        ev = {"event": name, "ts": time.time(), "ts_mono": time.monotonic()}
         for field, value in zip(self._FIELDS.get(name, ()), args):
             ev[field] = value
         if kwargs:
@@ -189,7 +193,8 @@ class InMemoryFlightRecorder(FlightRecorder):
             self._buf.append(ev)
 
     def event(self, name: str, **fields: Any) -> None:
-        self._append({"event": name, "ts": time.time(), **fields})
+        self._append({"event": name, "ts": time.time(),
+                      "ts_mono": time.monotonic(), **fields})
 
     def events(self) -> List[Dict[str, Any]]:
         with self._lock:
@@ -248,6 +253,25 @@ def from_config(config) -> FlightRecorder:
 
 
 # --------------------------------------------------------- jax.profiler side
+# one import attempt per process, not one per span (the old per-__enter__
+# `import jax.profiler` paid the sys.modules lookup + exception machinery
+# on every bracket); absent profiler stays a harmless noop forever
+_PROFILER: Any = None
+_PROFILER_TRIED = False
+
+
+def _profiler():
+    global _PROFILER, _PROFILER_TRIED
+    if not _PROFILER_TRIED:
+        _PROFILER_TRIED = True
+        try:
+            import jax.profiler as _p
+            _PROFILER = _p
+        except Exception:  # noqa: BLE001
+            _PROFILER = None
+    return _PROFILER
+
+
 class trace_span:
     """Context manager: annotate a host-side region so it shows up in a
     jax.profiler (XProf/TensorBoard) trace alongside the XLA ops it
@@ -260,9 +284,11 @@ class trace_span:
         self._cm = None
 
     def __enter__(self):
+        prof = _profiler()
+        if prof is None:
+            return self
         try:
-            import jax.profiler
-            self._cm = jax.profiler.TraceAnnotation(self._name)
+            self._cm = prof.TraceAnnotation(self._name)
             self._cm.__enter__()
         except Exception:  # noqa: BLE001 — tracing must never break the step
             self._cm = None

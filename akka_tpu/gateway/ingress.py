@@ -74,6 +74,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..batched.bridge import AskPoolExhausted
+from ..event.tracing import reset_ctx, set_ctx
 from ..serialization import frames
 from .admission import AdmissionController, Reject
 from .slo import SloTracker
@@ -189,14 +190,19 @@ class RegionBackend:
         return float(np.asarray(reply)[0])
 
     def ask_many(self, entity_ids: Sequence[str],
-                 values: Sequence[float]) -> List[Any]:
+                 values: Sequence[float],
+                 ctxs: Optional[Sequence[Any]] = None) -> List[Any]:
         """Columnar wave ask for a decoded binary window: entity ids are
         resolved ONCE per unique id, the whole wave rides
         `AskBatcher.ask_many` (one coalesced flush + one shared step
         budget, no per-call future hop) and the return is outcome-
         aligned — a float total or the per-ask exception INSTANCE
         (AskPoolExhausted / TimeoutError / ...), never a raise, so one
-        member's failure cannot fail its wave-mates."""
+        member's failure cannot fail its wave-mates.
+
+        `ctxs` (ISSUE 12): optional aligned per-request span contexts —
+        one window carries many traces, so each sampled member's ctx
+        travels next to its request instead of in the ambient var."""
         refs: Dict[str, Any] = {}
         for e in entity_ids:
             if e not in refs:
@@ -205,6 +211,7 @@ class RegionBackend:
                 except Exception as exc:  # noqa: BLE001 — per-entity typed
                     refs[e] = exc
         reqs, slots = [], []
+        req_ctxs: Optional[List[Any]] = [] if ctxs is not None else None
         out: List[Any] = [None] * len(entity_ids)
         for i, (e, v) in enumerate(zip(entity_ids, values)):
             r = refs[e]
@@ -213,13 +220,15 @@ class RegionBackend:
                 continue
             reqs.append((r.shard, r.index, [float(v)]))
             slots.append(i)
+            if req_ctxs is not None:
+                req_ctxs.append(ctxs[i])
         if reqs:
             if self.batcher is not None:
-                replies = self.batcher.ask_many(reqs)
+                replies = self.batcher.ask_many(reqs, req_ctxs)
             else:
                 replies = self.region.ask_many(
                     reqs, steps=self.steps,
-                    max_extra_steps=self.max_extra_steps)
+                    max_extra_steps=self.max_extra_steps, ctxs=req_ctxs)
             for i, rep in zip(slots, replies):
                 out[i] = rep if isinstance(rep, BaseException) \
                     else float(np.asarray(rep)[0])
@@ -261,7 +270,8 @@ class GatewayServer:
 
     def __init__(self, system, backend, admission: AdmissionController,
                  slo: SloTracker, host: str = "127.0.0.1", port: int = 0,
-                 max_frame: int = DEFAULT_MAX_FRAME, registry=None):
+                 max_frame: int = DEFAULT_MAX_FRAME, registry=None,
+                 tracer=None):
         self.system = system
         self.backend = backend
         self.admission = admission
@@ -272,6 +282,15 @@ class GatewayServer:
         self._binding = None
         self._seq = 0
         self._registry = registry
+        # causal tracing (event/tracing.py): explicit tracer wins, else
+        # the system-wired one (akka.tracing.* config); None keeps every
+        # hook below at one `is not None` predicate
+        self._tracer = tracer if tracer is not None \
+            else getattr(system, "tracer", None)
+        if self._tracer is not None:
+            region = getattr(backend, "region", None)
+            if region is not None and hasattr(region, "attach_tracer"):
+                region.attach_tracer(self._tracer)
         self._h_decode_size = self._h_decode_ns = None
         if registry is not None:
             self._h_decode_size = registry.histogram(
@@ -314,56 +333,99 @@ class GatewayServer:
     def handle_frame(self, frame: bytes) -> bytes:
         if frames.is_binary(frame):
             return self.handle_binary(frame)
+        tr = self._tracer
         try:
             req = json.loads(frame)
             rid = req.get("id", -1)
             tenant = str(req["tenant"])
             op = str(req["op"])
         except Exception as e:  # malformed frame: typed error, keep serving
-            return encode_body({"id": -1, "status": "error",
-                                "reason": f"bad_request:{type(e).__name__}"})
+            reason = f"bad_request:{type(e).__name__}"
+            trace = tr.start_trace() if tr is not None else 0
+            if trace:  # greppable: the reply's trace id is in the spans
+                t_now = time.monotonic()
+                tr.emit("gw.bad_request", trace, t0=t_now, t1=t_now,
+                        reason=reason, proto="json")
+            return encode_body(self._traced(
+                {"id": -1, "status": "error", "reason": reason}, trace))
         if tenant == ADMIN_TENANT:
             return encode_body(self._handle_admin(rid, op, req))
+        # head sampling: ONE decision per trace, made here at ingress
+        trace = tr.start_trace(tenant, rid) if tr is not None else 0
+        if not trace:
+            return encode_body(self._serve_json(rid, tenant, op, req, 0))
+        root = tr.span("gw.request", trace, id=rid, tenant=tenant, op=op,
+                       proto="json")
+        with root:  # sets the ambient ctx: submit() snapshots it
+            rep = self._serve_json(rid, tenant, op, req, trace)
+            root.set(status=rep.get("status"))
+        return encode_body(rep)
 
+    def _serve_json(self, rid, tenant: str, op: str, req: Dict[str, Any],
+                    trace: int) -> Dict[str, Any]:
+        """The JSON serving path behind the root span; every reply is
+        trace-stamped when the request was sampled (ISSUE 12 satellite:
+        a client-reported failure is greppable in the span JSONL)."""
+        tr = self._tracer
         if "entity" not in req:
             # typed BEFORE admission: a malformed frame must not charge
             # the tenant's token bucket and then surface as fault:KeyError
             self.slo.record(tenant, "error")
-            return encode_body({"id": rid, "status": "error",
-                                "reason": "bad_request:missing_entity"})
-        rej = self.admission.admit(tenant)
+            return self._traced(
+                {"id": rid, "status": "error",
+                 "reason": "bad_request:missing_entity"}, trace)
+        if trace:
+            with tr.span("gw.admit", trace):
+                rej = self.admission.admit(tenant)
+        else:
+            rej = self.admission.admit(tenant)
         if rej is not None:
             self.slo.record(tenant, "reject")
-            return encode_body(self._shed(rid, rej))
+            return self._traced(self._shed(rid, rej), trace)
         value = float(req.get("value", 0.0)) if op == "add" else 0.0
         if op not in ("add", "get"):
             self.slo.record(tenant, "error")
-            return encode_body({"id": rid, "status": "error",
-                                "reason": f"unknown_op:{op}"})
+            return self._traced({"id": rid, "status": "error",
+                                 "reason": f"unknown_op:{op}"}, trace)
         t0 = time.perf_counter()
         try:
-            total = self.backend.ask(str(req["entity"]), value)
+            if trace:
+                with tr.span("gw.ask", trace, entity=str(req["entity"])):
+                    total = self.backend.ask(str(req["entity"]), value)
+            else:
+                total = self.backend.ask(str(req["entity"]), value)
         except AskPoolExhausted:
             # the typed fast-fail the admission layer sheds on: convert to
             # a shed reply AND arm the controller's cooldown
             self.admission.note_ask_pool_exhausted()
             self.slo.record(tenant, "reject")
-            return encode_body(self._shed(
+            return self._traced(self._shed(
                 rid, Reject("ask_pool_exhausted",
-                            self.admission.cooldown_s)))
+                            self.admission.cooldown_s)), trace)
         except TimeoutError:
             self.slo.record(tenant, "timeout",
                             time.perf_counter() - t0)
-            return encode_body({"id": rid, "status": "error",
-                                "reason": "timeout"})
+            return self._traced({"id": rid, "status": "error",
+                                 "reason": "timeout"}, trace)
         except Exception as e:  # noqa: BLE001 — fault isolation per request
             # latency recorded on the fault leg too (the timeout leg always
             # did): error-leg p99s stay honest in the SLO artifact
             self.slo.record(tenant, "error", time.perf_counter() - t0)
-            return encode_body({"id": rid, "status": "error",
-                                "reason": f"fault:{type(e).__name__}"})
+            return self._traced({"id": rid, "status": "error",
+                                 "reason": f"fault:{type(e).__name__}"},
+                                trace)
         self.slo.record(tenant, "ok", time.perf_counter() - t0)
-        return encode_body({"id": rid, "status": "ok", "value": total})
+        return self._traced({"id": rid, "status": "ok", "value": total},
+                            trace)
+
+    @staticmethod
+    def _traced(rep: Dict[str, Any], trace: int) -> Dict[str, Any]:
+        """Mirror the trace id into the reply — EVERY reply of a sampled
+        request, so the JSON dict stays the exact twin of a version-2
+        binary record's reply_to_dict (trace column on all records)."""
+        if trace:
+            rep["trace"] = trace
+        return rep
 
     @staticmethod
     def _shed(rid, rej: Reject) -> Dict[str, Any]:
@@ -372,24 +434,29 @@ class GatewayServer:
 
     # ------------------------------------------------------ binary requests
     @staticmethod
-    def _binary_error(code: str) -> bytes:
+    def _binary_error(code: str, trace: int = 0) -> bytes:
         """Typed malformed-binary reply (the `bad_request:` twin): one
         error record with id -1, mirroring the JSON path's keep-serving
-        discipline."""
+        discipline. A sampled decode failure carries its trace id (the
+        version-2 reply record) so the failure is greppable server-side."""
         return frames.encode_reply_batch(
             np.asarray([-1], np.int64),
             np.asarray([frames.ST_ERROR], np.uint8),
             np.asarray([f"bad_frame:{code}".encode("utf-8")
                         [:frames.REASON_BYTES]]),
-            np.zeros(1), np.zeros(1, np.uint32))
+            np.zeros(1), np.zeros(1, np.uint32),
+            np.asarray([trace], np.uint64) if trace else None)
 
     def handle_binary(self, body: bytes) -> bytes:
         """One binary window: batch decode -> columnar serve -> one
         vectorized reply encode."""
+        t0d = time.monotonic() if self._tracer is not None else 0.0
         rec = self._decode_window([body])
         if isinstance(rec, bytes):  # typed decode error
             return rec
-        cols = self._serve_records(rec)
+        decode_t = (t0d, time.monotonic()) \
+            if self._tracer is not None else None
+        cols = self._serve_records(rec, decode_t)
         return frames.encode_reply_batch(*cols)
 
     def handle_frame_batch(self, bodies: Sequence[bytes]) -> List[bytes]:
@@ -419,13 +486,14 @@ class GatewayServer:
                 j += 1
             if recs:
                 merged = np.concatenate(recs) if len(recs) > 1 else recs[0]
-                ids, st, rsn, val, retry = self._serve_records(merged)
+                ids, st, rsn, val, retry, trc = self._serve_records(merged)
                 lo = 0
                 for idx, n in spans:
                     hi = lo + n
                     out[idx] = frames.encode_reply_batch(
                         ids[lo:hi], st[lo:hi], rsn[lo:hi], val[lo:hi],
-                        retry[lo:hi])
+                        retry[lo:hi],
+                        None if trc is None else trc[lo:hi])
                     lo = hi
             i = j
         return out  # type: ignore[return-value]
@@ -440,7 +508,13 @@ class GatewayServer:
                     for b in bodies]
             rec = np.concatenate(recs) if len(recs) > 1 else recs[0]
         except frames.FrameFormatError as e:
-            return self._binary_error(e.code)
+            tr = self._tracer
+            trace = tr.start_trace() if tr is not None else 0
+            if trace:  # the bad_frame reply's trace id is in the spans
+                t_now = time.monotonic()
+                tr.emit("gw.bad_frame", trace, t0=t_now, t1=t_now,
+                        reason=f"bad_frame:{e.code}", proto="binary")
+            return self._binary_error(e.code, trace)
         if self._h_decode_size is not None:
             dt = time.perf_counter_ns() - t0
             step = self._registry.step
@@ -448,7 +522,7 @@ class GatewayServer:
             self._h_decode_ns.observe(dt / len(rec), step=step)
         return rec
 
-    def _serve_records(self, rec: np.ndarray):
+    def _serve_records(self, rec: np.ndarray, decode_t=None):
         """The columnar twin of the JSON request path, one whole window
         at a time: admin/malformed checks -> vectorized per-tenant
         admission charge -> ONE ask wave -> vectorized reply columns.
@@ -456,7 +530,14 @@ class GatewayServer:
         typed BEFORE admission and never charges the bucket; unknown op
         is typed AFTER admission, charged, like JSON); SLO counters are
         recorded per tenant with `record_many` — counter-identical to N
-        JSON requests."""
+        JSON requests.
+
+        Tracing (ISSUE 12): each record gets its own head-sampled trace
+        at ingress (one window holds MANY traces); sampled records get a
+        root span whose ctx rides next to the request through the ask
+        wave, and the reply wave carries the trace-id column (version-2
+        records) when any record was sampled. Tracing off ⇒ one
+        predicate, identical columns, version-1 bytes."""
         n = len(rec)
         ids = rec["id"].astype(np.int64)
         ops = rec["op"]
@@ -466,6 +547,28 @@ class GatewayServer:
         reason = np.zeros((n,), f"S{frames.REASON_BYTES}")
         value = np.zeros((n,), np.float64)
         retry = np.zeros((n,), np.uint32)
+
+        tr = self._tracer
+        traces = None
+        roots: Dict[int, Any] = {}
+        if tr is not None:
+            traces = np.zeros((n,), np.uint64)
+            for i in range(n):
+                tid = tr.start_trace(
+                    tenants[i].decode("utf-8", "replace"), int(ids[i]))
+                if tid:
+                    traces[i] = tid
+                    roots[i] = tr.begin(
+                        "gw.request", tid, id=int(ids[i]),
+                        tenant=tenants[i].decode("utf-8", "replace"),
+                        op=int(ops[i]), proto="binary")
+            if roots and decode_t is not None:
+                # the window's decode, retro-emitted under the first
+                # sampled root (one decode serves many traces — the
+                # wave-span convention)
+                first = next(iter(roots.values()))
+                tr.emit("gw.decode", first.ctx, t0=decode_t[0],
+                        t1=decode_t[1], n_records=n)
 
         admin = tenants == ADMIN_TENANT.encode("utf-8")
         reason[admin] = b"bad_request:admin_requires_json"
@@ -482,6 +585,12 @@ class GatewayServer:
             slo_lat.setdefault(t, []).extend([lat] * count)
 
         # ---- vectorized per-tenant admission charge (one debit/tenant)
+        aspan = None
+        if roots:  # one admit_batch span joined to the rest by traces
+            aspan = tr.begin("gw.admit_batch",
+                             next(iter(roots.values())).ctx,
+                             member_traces=[s.trace_id
+                                            for s in roots.values()])
         admitted = np.zeros((n,), bool)
         for t in np.unique(tenants[eligible]) if eligible.any() else ():
             rows = np.nonzero(eligible & (tenants == t))[0]
@@ -494,6 +603,8 @@ class GatewayServer:
                     [:frames.REASON_BYTES]
                 retry[shed] = int(rej.retry_after_s * 1e3)
                 note(t, "reject", count=len(shed))
+        if aspan is not None:
+            aspan.finish(admitted=int(admitted.sum()))
 
         # unknown-op is typed AFTER admission (the JSON path charges the
         # bucket before it inspects the op)
@@ -511,8 +622,12 @@ class GatewayServer:
             vals = np.where(ops[serve] == frames.OP_ADD,
                             rec["value"][serve].astype(np.float64), 0.0)
             ents = [entities[i].decode("utf-8") for i in serve]
+            ctxs = None
+            if roots:  # each sampled request's ctx rides with its ask
+                ctxs = [roots[i].ctx if i in roots else None
+                        for i in serve]
             t0 = time.perf_counter()
-            outcomes = self._backend_ask_many(ents, vals)
+            outcomes = self._backend_ask_many(ents, vals, ctxs)
             dt = time.perf_counter() - t0
             pool_noted = False
             for i, outc in zip(serve, outcomes):
@@ -539,19 +654,37 @@ class GatewayServer:
 
         for t, outs in slo_outcomes.items():
             self.slo.record_many(t.decode("utf-8"), outs, slo_lat[t])
-        return ids, status, reason, value, retry
+        if roots:
+            st_names = {frames.ST_OK: "ok", frames.ST_SHED: "shed",
+                        frames.ST_ERROR: "error"}
+            for i, sp in roots.items():
+                rsn = bytes(reason[i]).rstrip(b"\x00")
+                sp.finish(status=st_names.get(int(status[i]), "error"),
+                          **({"reason": rsn.decode("utf-8", "replace")}
+                             if rsn else {}))
+        return ids, status, reason, value, retry, traces
 
     def _backend_ask_many(self, entity_ids: List[str],
-                          values: np.ndarray) -> List[Any]:
+                          values: np.ndarray,
+                          ctxs: Optional[List[Any]] = None) -> List[Any]:
         asker = getattr(self.backend, "ask_many", None)
         if asker is not None:
-            return asker(entity_ids, values)
+            # ctxs exist only when tracing is on; backends that batch
+            # (RegionBackend) accept them, and the fallback loop below
+            # pins each member's ctx as the ambient one per ask
+            return asker(entity_ids, values) if ctxs is None \
+                else asker(entity_ids, values, ctxs)
         out: List[Any] = []
-        for e, v in zip(entity_ids, values):
+        for j, (e, v) in enumerate(zip(entity_ids, values)):
+            tok = set_ctx(ctxs[j]) \
+                if ctxs is not None and ctxs[j] is not None else None
             try:
                 out.append(self.backend.ask(e, float(v)))
             except Exception as exc:  # noqa: BLE001 — per-ask outcome
                 out.append(exc)
+            finally:
+                if tok is not None:
+                    reset_ctx(tok)
         return out
 
     # ---------------------------------------------------------------- admin

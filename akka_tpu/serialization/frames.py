@@ -32,6 +32,15 @@ Reply record (53 bytes):
     id i64 | status u8 (0=ok, 1=shed, 2=error) | reason S32
     | value f64 | retry_after_ms u32
 
+Traced reply record (version 2, 61 bytes — ISSUE 12): the same fields
+plus a trailing `trace u64`, the causal trace id minted at ingress, so a
+client-reported failure is greppable in the span JSONL. Version 2 is
+emitted ONLY when some record in the wave actually carries a nonzero
+trace id (tracing enabled AND the request sampled) — an untraced wave's
+bytes are bit-identical to version 1, and version-1 decoders never see a
+frame they cannot parse unless tracing was deliberately turned on.
+Request frames stay version 1.
+
 String fields are NUL-padded UTF-8; a reason longer than 32 bytes is
 truncated (every typed gateway reason fits). A batch of one is the solo
 ask — bit-identical semantics to its JSON twin, tested in
@@ -52,10 +61,11 @@ import numpy as np
 
 from .codec import _U32
 
-__all__ = ["MAGIC", "VERSION", "KIND_REQUEST", "KIND_REPLY",
-           "OP_GET", "OP_ADD", "OP_NAMES", "OP_CODES",
+__all__ = ["MAGIC", "VERSION", "VERSION_TRACED", "KIND_REQUEST",
+           "KIND_REPLY", "OP_GET", "OP_ADD", "OP_NAMES", "OP_CODES",
            "ST_OK", "ST_SHED", "ST_ERROR",
-           "REQUEST_DTYPE", "REPLY_DTYPE", "DEFAULT_MAX_FRAME",
+           "REQUEST_DTYPE", "REPLY_DTYPE", "REPLY_DTYPE_TRACED",
+           "DEFAULT_MAX_FRAME",
            "FrameFormatError", "is_binary", "frame",
            "encode_request_batch", "decode_request_batch",
            "encode_reply_batch", "decode_reply_batch", "reply_to_dict",
@@ -63,6 +73,7 @@ __all__ = ["MAGIC", "VERSION", "KIND_REQUEST", "KIND_REPLY",
 
 MAGIC = 0xAB
 VERSION = 1
+VERSION_TRACED = 2  # replies only: VERSION layout + trailing trace u64
 KIND_REQUEST = 0
 KIND_REPLY = 1
 
@@ -98,6 +109,9 @@ REPLY_DTYPE = np.dtype([("id", ">i8"), ("status", "u1"),
                         ("reason", f"S{REASON_BYTES}"),
                         ("value", ">f8"), ("retry_after_ms", ">u4")])
 
+# version-2 reply record: version 1 + the causal trace id (ISSUE 12)
+REPLY_DTYPE_TRACED = np.dtype(REPLY_DTYPE.descr + [("trace", ">u8")])
+
 
 class FrameFormatError(ValueError):
     """Malformed binary frame. `code` is the short typed-reason slug the
@@ -121,10 +135,10 @@ def frame(body: bytes) -> bytes:
     return _U32.pack(len(body)) + body
 
 
-def _header(kind: int, count: int) -> bytes:
+def _header(kind: int, count: int, version: int = VERSION) -> bytes:
     h = np.zeros((), _HEADER)
     h["magic"] = MAGIC
-    h["version"] = VERSION
+    h["version"] = version
     h["kind"] = kind
     h["count"] = count
     return h.tobytes()
@@ -159,7 +173,7 @@ def encode_request_batch(ids: Sequence[int], tenants: Sequence[Any],
 
 
 def _decode_records(body: bytes, kind: int, dtype: np.dtype,
-                    max_frame: int) -> np.ndarray:
+                    max_frame: int, version: int = VERSION) -> np.ndarray:
     if len(body) > max_frame:
         raise FrameFormatError("oversize",
                                f"{len(body)} bytes exceeds {max_frame}")
@@ -169,7 +183,7 @@ def _decode_records(body: bytes, kind: int, dtype: np.dtype,
     h = np.frombuffer(body[:_HEADER.itemsize], _HEADER)[0]
     if int(h["magic"]) != MAGIC:
         raise FrameFormatError("bad_magic", hex(int(h["magic"])))
-    if int(h["version"]) != VERSION:
+    if int(h["version"]) != version:
         raise FrameFormatError("unsupported_version", str(int(h["version"])))
     if int(h["kind"]) != kind:
         raise FrameFormatError("wrong_kind",
@@ -196,29 +210,45 @@ def decode_request_batch(body: bytes,
 # ------------------------------------------------------------------- replies
 def encode_reply_batch(ids: np.ndarray, statuses: np.ndarray,
                        reasons: np.ndarray, values: np.ndarray,
-                       retry_after_ms: np.ndarray) -> bytes:
+                       retry_after_ms: np.ndarray,
+                       traces: Any = None) -> bytes:
     """Encode a whole reply wave in one vectorized pass (columns in,
-    bytes out — the readback twin of decode_request_batch)."""
+    bytes out — the readback twin of decode_request_batch).
+
+    `traces` (ISSUE 12): optional aligned u64 trace-id column. When any
+    id is nonzero the wave is encoded as version 2 (trailing trace
+    field); otherwise the output is bit-identical to the pre-tracing
+    version-1 bytes — an untraced server never changes the wire."""
     n = len(ids)
-    rec = np.zeros((n,), REPLY_DTYPE)
+    traced = traces is not None and bool(np.any(np.asarray(traces)))
+    rec = np.zeros((n,), REPLY_DTYPE_TRACED if traced else REPLY_DTYPE)
     rec["id"] = ids
     rec["status"] = statuses
     rec["reason"] = reasons
     rec["value"] = values
     rec["retry_after_ms"] = retry_after_ms
+    if traced:
+        rec["trace"] = np.asarray(traces, np.uint64)
+        return _header(KIND_REPLY, n, VERSION_TRACED) + rec.tobytes()
     return _header(KIND_REPLY, n) + rec.tobytes()
 
 
 def decode_reply_batch(body: bytes,
                        max_frame: int = DEFAULT_MAX_FRAME) -> np.ndarray:
-    """Decode a reply wave to its record columns (client half)."""
+    """Decode a reply wave to its record columns (client half). Accepts
+    both reply versions: 1 (53B records) and 2 (61B traced records) —
+    the record array's dtype tells the caller which it got."""
+    if len(body) >= 2 and body[1] == VERSION_TRACED:
+        return _decode_records(body, KIND_REPLY, REPLY_DTYPE_TRACED,
+                               max_frame, VERSION_TRACED)
     return _decode_records(body, KIND_REPLY, REPLY_DTYPE, max_frame)
 
 
 def reply_to_dict(rec) -> Dict[str, Any]:
     """One reply record -> the exact dict the JSON protocol would have
     produced (key set depends on status — the equivalence contract the
-    property test pins)."""
+    property test pins). A version-2 record's nonzero trace id maps to
+    the "trace" key, exactly as the JSON path mirrors it."""
     status = _ST_NAMES.get(int(rec["status"]), "error")
     out: Dict[str, Any] = {"id": int(rec["id"]), "status": status}
     if status == "ok":
@@ -228,6 +258,8 @@ def reply_to_dict(rec) -> Dict[str, Any]:
         out["retry_after_ms"] = int(rec["retry_after_ms"])
     else:
         out["reason"] = bytes(rec["reason"]).decode("utf-8", "replace")
+    if "trace" in (rec.dtype.names or ()) and int(rec["trace"]):
+        out["trace"] = int(rec["trace"])
     return out
 
 

@@ -41,19 +41,27 @@ from typing import Any, List, Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
+from ..event.tracing import NOOP_SPAN, current_ctx, reset_ctx, set_ctx
+
 __all__ = ["BatchAsk", "execute_ask_batch", "AskBatcher"]
 
 
 class BatchAsk:
     """One ask riding a batch: request in, outcome (reply payload or the
-    per-ask exception instance) out."""
+    per-ask exception instance) out.
+
+    `trace` is the submitter's span context (event/tracing.py SpanCtx,
+    None when the request is unsampled) snapshotted at submit time —
+    that snapshot is what carries causality across the dispatcher
+    thread hop and into columnar waves."""
 
     __slots__ = ("shard", "index", "message", "steps", "max_extra_steps",
                  "slot", "prow", "row", "start", "outcome", "future",
-                 "t_submit")
+                 "t_submit", "trace", "t_stage", "step_stage")
 
     def __init__(self, shard: int, index: int, message: Any,
-                 steps: int = 2, max_extra_steps: int = 8):
+                 steps: int = 2, max_extra_steps: int = 8,
+                 trace=None):
         self.shard = shard
         self.index = index
         self.message = message
@@ -66,6 +74,9 @@ class BatchAsk:
         self.outcome: Any = None
         self.future: Optional[Future] = None
         self.t_submit = 0.0
+        self.trace = trace
+        self.t_stage = 0.0
+        self.step_stage = 0
 
 
 def _reset_batch_latches(region, slots: Sequence[int]) -> None:
@@ -129,75 +140,129 @@ def execute_ask_batch(region, batch: Sequence[BatchAsk]) -> None:
     if not live:
         return
 
-    _reset_batch_latches(region, [a.slot for a in live])
-
-    # -- wave scheduling: at most ONE in-flight ask per destination row
-    # (see module docstring); each wave's tells coalesce into the next
-    # run's single flush
-    waiting = list(live)
-    in_flight = {}  # row -> BatchAsk
+    # every wave (= one engine invocation, serialized by _ask_lock) gets
+    # a monotone wave_id; the same counter is what AskBatcher.stats()
+    # surfaces as last_wave_id, so span wave_ids and collector stats can
+    # be cross-checked (ISSUE 12)
+    region._wave_seq = wave_id = getattr(region, "_wave_seq", 0) + 1
+    tracer = getattr(region, "tracer", None)
+    wspan = NOOP_SPAN
+    if tracer is not None:
+        sampled = [a for a in live if a.trace is not None]
+        if sampled:
+            # ONE wave span regardless of how many sampled members ride
+            # it: rooted in the first member's trace, joined to the rest
+            # by wave_id + member_traces (the request-tree join key)
+            wspan = tracer.begin(
+                "ask.wave", sampled[0].trace, parent=0, wave_id=wave_id,
+                n_members=len(live), n_sampled=len(sampled),
+                member_traces=[a.trace.trace_id for a in sampled])
     cum = 0  # steps run so far in this batch
+    rounds = 0
+    try:
+        with wspan.child("wave.latch_reset", wave_id=wave_id):
+            _reset_batch_latches(region, [a.slot for a in live])
 
-    def stage_ready() -> None:
-        nonlocal waiting
-        rest: List[BatchAsk] = []
-        for a in waiting:
-            if a.row in in_flight:
-                rest.append(a)
-                continue
-            payload = np.zeros((sys.payload_width,), np.float32)
-            body = np.atleast_1d(
-                np.asarray(a.message, np.float32)).reshape(-1)
-            payload[:min(len(body), sys.payload_width - 1)] = \
-                body[:sys.payload_width - 1]
-            payload[-1] = float(a.prow)
-            sys.tell(a.row, payload)
-            a.start = cum
-            in_flight[a.row] = a
-        waiting = rest
+        # -- wave scheduling: at most ONE in-flight ask per destination
+        # row (see module docstring); each wave's tells coalesce into
+        # the next run's single flush
+        waiting = list(live)
+        in_flight = {}  # row -> BatchAsk
 
-    stage_ready()
-    first = True
-    while in_flight:
-        # shared budget: one `steps`-deep round for the whole wave, then
-        # single steps — a batch of one runs the exact schedule the
-        # pre-batching ask() ran ([steps] + [1]*max_extra_steps)
-        n_steps = min(a.steps for a in in_flight.values()) if first else 1
-        first = False
-        sys.run(n_steps)
-        cum += n_steps
-        # "all replied?" rides the attention word: the tiny device_get
-        # doubles as the run's sync (bridge _drain_one idiom), and the
-        # wide promise-block readback is paid only when ATT_LATCH_BIT
-        # says some latch is actually high
-        att = decode_attention(sys.attention)
-        replied_blk = reply_blk = None
-        if att["any_latched"] or not getattr(region, "_ask_latch_wired",
-                                             False):
-            from ..batched.bridge import read_promise_block
-            replied_blk, reply_blk = read_promise_block(
-                sys.state, base, eps, "__promise_replied",
-                "__promise_reply")
-        done_rows: List[int] = []
-        for row, a in in_flight.items():
-            if replied_blk is not None and bool(replied_blk[a.slot]):
-                a.outcome = np.asarray(reply_blk[a.slot])
-                with region._lock:
-                    region._promise_free.append(a.slot)
-                done_rows.append(row)
-            elif cum - a.start >= a.steps + a.max_extra_steps:
-                # timed out: RETIRE the slot (late replies must land in a
-                # row no future ask will read); _reclaim_promise_slots
-                # returns it once the straggler's latch shows up
-                with region._lock:
-                    region._promise_retired.append(a.slot)
-                a.outcome = TimeoutError(
-                    f"ask to shard {a.shard} index {a.index} unanswered "
-                    f"after {a.steps + a.max_extra_steps} steps")
-                done_rows.append(row)
-        for row in done_rows:
-            del in_flight[row]
-        stage_ready()  # duplicates deferred from earlier waves
+        def stage_ready() -> None:
+            nonlocal waiting
+            rest: List[BatchAsk] = []
+            for a in waiting:
+                if a.row in in_flight:
+                    rest.append(a)
+                    continue
+                payload = np.zeros((sys.payload_width,), np.float32)
+                body = np.atleast_1d(
+                    np.asarray(a.message, np.float32)).reshape(-1)
+                payload[:min(len(body), sys.payload_width - 1)] = \
+                    body[:sys.payload_width - 1]
+                payload[-1] = float(a.prow)
+                sys.tell(a.row, payload)
+                a.start = cum
+                if a.trace is not None:
+                    a.t_stage = time.monotonic()
+                    a.step_stage = int(sys._host_step)
+                in_flight[a.row] = a
+            waiting = rest
+
+        def resolve_member(a: BatchAsk, outcome: str) -> None:
+            # retro-emitted: the member's in-flight window (staged ->
+            # resolved), parented under the SUBMITTER's span so the
+            # request tree crosses the thread hop intact
+            tracer.emit("ask.member", a.trace, t0=a.t_stage,
+                        t1=time.monotonic(), step0=a.step_stage,
+                        step1=int(sys._host_step), wave_id=wave_id,
+                        slot=a.slot, row=a.row, deferred=a.start > 0,
+                        outcome=outcome)
+
+        with wspan.child("wave.flush", wave_id=wave_id, coalesced=True,
+                         n_staged=len(waiting)):
+            stage_ready()
+        first = True
+        rounds = 0
+        while in_flight:
+            # shared budget: one `steps`-deep round for the whole wave,
+            # then single steps — a batch of one runs the exact schedule
+            # the pre-batching ask() ran ([steps] + [1]*max_extra_steps)
+            n_steps = min(a.steps for a in in_flight.values()) \
+                if first else 1
+            first = False
+            rounds += 1
+            with wspan.child("wave.step_round", wave_id=wave_id,
+                             n_steps=n_steps, round=rounds) as rspan:
+                sys.run(n_steps)
+                rspan.set(host_step=int(sys._host_step))
+            cum += n_steps
+            # "all replied?" rides the attention word: the tiny
+            # device_get doubles as the run's sync (bridge _drain_one
+            # idiom), and the wide promise-block readback is paid only
+            # when ATT_LATCH_BIT says some latch is actually high
+            att = decode_attention(sys.attention)
+            replied_blk = reply_blk = None
+            if att["any_latched"] or not getattr(region, "_ask_latch_wired",
+                                                 False):
+                from ..batched.bridge import read_promise_block
+                with wspan.child("wave.readback", wave_id=wave_id,
+                                 round=rounds):
+                    replied_blk, reply_blk = read_promise_block(
+                        sys.state, base, eps, "__promise_replied",
+                        "__promise_reply")
+            done_rows: List[int] = []
+            for row, a in in_flight.items():
+                if replied_blk is not None and bool(replied_blk[a.slot]):
+                    a.outcome = np.asarray(reply_blk[a.slot])
+                    with region._lock:
+                        region._promise_free.append(a.slot)
+                    if a.trace is not None and tracer is not None:
+                        resolve_member(a, "reply")
+                    done_rows.append(row)
+                elif cum - a.start >= a.steps + a.max_extra_steps:
+                    # timed out: RETIRE the slot (late replies must land
+                    # in a row no future ask will read);
+                    # _reclaim_promise_slots returns it once the
+                    # straggler's latch shows up
+                    with region._lock:
+                        region._promise_retired.append(a.slot)
+                    a.outcome = TimeoutError(
+                        f"ask to shard {a.shard} index {a.index} "
+                        f"unanswered after "
+                        f"{a.steps + a.max_extra_steps} steps")
+                    if a.trace is not None and tracer is not None:
+                        resolve_member(a, "timeout")
+                    done_rows.append(row)
+            for row in done_rows:
+                del in_flight[row]
+            if waiting:  # duplicates deferred from earlier waves
+                with wspan.child("wave.flush", wave_id=wave_id,
+                                 deferred=True, n_staged=len(waiting)):
+                    stage_ready()
+    finally:
+        wspan.finish(rounds=rounds, steps=cum)
 
 
 class AskBatcher:
@@ -252,7 +317,12 @@ class AskBatcher:
         a = BatchAsk(int(shard), int(index), message,
                      self.steps if steps is None else int(steps),
                      self.max_extra_steps if max_extra_steps is None
-                     else int(max_extra_steps))
+                     else int(max_extra_steps),
+                     # the submitter's span ctx crosses the dispatcher
+                     # thread hop pinned to the ask itself (None when the
+                     # request is unsampled — the one read the quiet path
+                     # pays)
+                     trace=current_ctx())
         a.future = Future()
         a.t_submit = time.perf_counter()
         with self._lock:
@@ -276,11 +346,17 @@ class AskBatcher:
         return self.submit(shard, index, message, steps,
                            max_extra_steps).result()
 
-    def ask_many(self, requests: Sequence[Any]) -> List[Any]:
+    def ask_many(self, requests: Sequence[Any],
+                 ctxs: Optional[Sequence[Any]] = None) -> List[Any]:
         """Columnar wave entry (ISSUE 11): `requests` is a sequence of
         `(shard, index, message)` decoded from one binary window.
         Returns outcomes aligned with `requests` — the reply payload or
         the per-ask exception INSTANCE (never raises per-ask).
+
+        `ctxs` (ISSUE 12): optional aligned per-member span contexts —
+        one binary window carries MANY traces, so the ambient contextvar
+        cannot represent it; the gateway passes each sampled record's
+        root ctx explicitly.
 
         A multi-request wave IS already a batch, so it skips the
         per-call future hop and the dispatcher window entirely: the
@@ -294,15 +370,24 @@ class AskBatcher:
             return []
         if len(reqs) == 1:
             s, i, m = reqs[0]
+            tok = None
+            if ctxs is not None and ctxs[0] is not None:
+                tok = set_ctx(ctxs[0])  # submit() snapshots it per ask
             try:
                 return [self.ask(s, i, m)]
             except BaseException as e:  # noqa: BLE001 — outcome convention
                 return [e]
+            finally:
+                if tok is not None:
+                    reset_ctx(tok)
         with self._lock:
             if self._closed:
                 raise RuntimeError("AskBatcher is closed")
         batch = [BatchAsk(int(s), int(i), m, self.steps,
                           self.max_extra_steps) for s, i, m in reqs]
+        if ctxs is not None:
+            for a, c in zip(batch, ctxs):
+                a.trace = c
         region = self.region
         t0 = time.perf_counter()
         # waves larger than the promise pool ride consecutive sub-batches
@@ -412,4 +497,11 @@ class AskBatcher:
                     "mean_batch_size": (n / b) if b else 0.0,
                     "max_batch_size": float(self._max_seen),
                     "multi_ask_batches": float(self._multi),
-                    "pending": float(len(self._pending))}
+                    "pending": float(len(self._pending)),
+                    # the engine's wave counter (ISSUE 12): every
+                    # execute_ask_batch invocation is one wave, and this
+                    # is the id the newest wave's spans carry — the
+                    # cross-check key between the trace timeline and
+                    # these stats
+                    "last_wave_id": float(
+                        getattr(self.region, "_wave_seq", 0))}

@@ -165,6 +165,11 @@ class DeviceShardRegion:
         self._promise_retired: List[int] = []
         self._promise_spawned = False
         self._stat_ask_exhausted = 0  # typed AskPoolExhausted fast-fails
+        # causal tracing (event/tracing.py): the ask engine reads these —
+        # None tracer keeps the engine on its one-predicate quiet path;
+        # _wave_seq numbers every execute_ask_batch invocation
+        self.tracer = None
+        self._wave_seq = 0
         self._lock = threading.Lock()
         # asks AND maintenance ops (checkpoint/rebalance/failover/restore)
         # serialize: all of them step or swap the shared runtime. Reentrant
@@ -254,8 +259,20 @@ class DeviceShardRegion:
             raise out
         return out
 
+    def attach_tracer(self, tracer) -> None:
+        """Wire the causal tracer (event/tracing.py) into the ask engine:
+        wave/member spans are emitted for sampled asks, and the tracer's
+        step source becomes this region's runtime — the authoritative
+        ATT_STEP axis for the spans describing its waves. Failover swaps
+        `self.system`; the lambda reads it dynamically, so spans keep
+        stamping the LIVE step axis across rebuilds."""
+        self.tracer = tracer
+        if tracer is not None:
+            tracer.step_fn = lambda: self.system._host_step
+
     def ask_many(self, requests: Sequence[Any], steps: int = 2,
-                 max_extra_steps: int = 8) -> List[Any]:
+                 max_extra_steps: int = 8,
+                 ctxs: Optional[Sequence[Any]] = None) -> List[Any]:
         """Coalesced asks: `requests` is a sequence of
         `(shard, index, message)`; every member gets its own promise row,
         all the tells go out in ONE flush, and the whole batch shares one
@@ -271,6 +288,9 @@ class DeviceShardRegion:
         from .ask_batch import BatchAsk, execute_ask_batch
         batch = [BatchAsk(int(s), int(i), m, int(steps),
                           int(max_extra_steps)) for s, i, m in requests]
+        if ctxs is not None:  # per-member span ctxs (one window, N traces)
+            for a, c in zip(batch, ctxs):
+                a.trace = c
         with self._ask_lock:
             execute_ask_batch(self, batch)
         return [a.outcome for a in batch]

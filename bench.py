@@ -1305,6 +1305,94 @@ def bench_gateway_binary_ab(region, per_leg: int = 384, window: int = 16):
             "ok": speedup >= 2.0}
 
 
+def bench_tracing_overhead(region, per_leg: int = 384):
+    """tracing-overhead (ISSUE 12): the gateway 64-client batched leg
+    (same mix as bench_gateway_concurrency) run three ways on one shared
+    warm region — tracing OFF, head-sampled at 1%, sampled at 100% — so
+    the artifact pins what the causal-tracing layer costs at each
+    setting. The contract is the OFF leg: with no tracer attached the
+    hot path pays one `tracer is None` predicate per hook, so the
+    1%-sampled leg must sit within load noise of off (the <=1% claim;
+    the bench `ok` bound is 5% because these host-side req/s rows swing
+    with loadavg — the stamp rides every row). The 100% leg is the
+    honest worst case: every request carries a full span tree plus the
+    JSONL-less ring emit."""
+    import threading as _threading
+
+    from akka_tpu.event.tracing import Tracer
+    from akka_tpu.gateway import (AdmissionController, GatewayServer,
+                                  RegionBackend, SloTracker)
+
+    clients = 64
+    per_client = max(1, per_leg // clients)
+
+    def leg(mode: str, sample_rate, n_clients: int = clients,
+            reqs_per_client: int = per_client):
+        tracer = (None if sample_rate is None
+                  else Tracer(sample_rate=sample_rate, seed=7))
+        if tracer is None:
+            region.tracer = None  # a prior traced leg must not leak
+        backend = RegionBackend(region, batch=True, max_batch=64)
+        slo = SloTracker(target_p50_ms=50.0, target_p99_ms=250.0)
+        adm = AdmissionController(rate=1e9, burst=1e9)
+        slo.attach_batcher(backend.batcher)
+        srv = GatewayServer(None, backend, adm, slo, tracer=tracer)
+        not_ok = []
+
+        def worker(w: int):
+            for i in range(reqs_per_client):
+                body = json.dumps(
+                    {"id": i, "tenant": f"t{w % 4}", "entity": f"tr{w}",
+                     "op": "add" if i % 4 else "get",
+                     "value": float(i % 5 + 1)}).encode()
+                rep = json.loads(srv.handle_frame(body))
+                if rep["status"] != "ok":
+                    not_ok.append(rep["status"])
+
+        threads = [_threading.Thread(target=worker, args=(w,))
+                   for w in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        n = reqs_per_client * n_clients
+        art = slo.artifact()
+        row = {"mode": mode, "clients": n_clients, "requests": n,
+               "wall_s": round(dt, 3), "req_per_sec": round(n / dt, 1),
+               "not_ok": len(not_ok),
+               "p50_ms": art["p50_ms"], "p99_ms": art["p99_ms"]}
+        try:
+            row["host_loadavg"] = round(os.getloadavg()[0], 2)
+        except OSError:
+            pass
+        if tracer is not None:
+            spans = tracer.spans()
+            row["spans"] = len(spans)
+            row["sampled_requests"] = sum(
+                1 for s in spans if s["name"] == "gw.request")
+            tracer.close()
+        backend.close()
+        return row
+
+    leg("warmup", None, reqs_per_client=1)  # entity spawn + compile
+    off = leg("off", None)
+    s1 = leg("sampled_1pct", 0.01)
+    full = leg("full", 1.0)
+
+    def overhead(row):
+        return round((off["req_per_sec"] /
+                      max(row["req_per_sec"], 1e-9) - 1.0) * 100, 2)
+
+    return {"off": off, "sampled_1pct": s1, "full": full,
+            "overhead_sampled_pct": overhead(s1),
+            "overhead_full_pct": overhead(full),
+            "sampling_working": (s1.get("sampled_requests", 0)
+                                 < full.get("sampled_requests", 0)),
+            "ok": overhead(s1) <= 5.0}
+
+
 def bench_ingest_decode(n_requests: int = 8192, window: int = 64,
                         per_leg: int = 768):
     """ingest-decode (ISSUE 11): how fast wire bytes become served
@@ -1518,6 +1606,7 @@ def main() -> None:
                                          "metrics-overhead",
                                          "failover-mttr", "reshard-pause",
                                          "gateway-slo", "ingest-decode",
+                                         "tracing-overhead",
                                          "spawn", "stream"],
                     help="run a single config (spawn/stream are extra "
                          "JMH-analogue microbenches outside the default "
@@ -1831,6 +1920,34 @@ def main() -> None:
                     "value": b["p99_ms"], "unit": "ms",
                     "vs_baseline": 1.0,
                     "extra": {"gateway": out, **extra}}))
+            elif args.config == "tracing-overhead":
+                import jax as _jax
+
+                from akka_tpu.gateway import counter_behavior
+                from akka_tpu.sharding.device import (DeviceEntity,
+                                                      DeviceShardRegion)
+                spec = DeviceEntity(
+                    "bench_trc", counter_behavior(4), n_shards=4,
+                    entities_per_shard=64,
+                    n_devices=min(2, len(_jax.devices())),
+                    payload_width=4)
+                trc_leg = 128 if args.smoke else 384
+                out = bench_tracing_overhead(DeviceShardRegion(spec),
+                                             per_leg=trc_leg)
+                print(f"[bench] tracing-overhead: "
+                      f"off={out['off']['req_per_sec']}req/s "
+                      f"1%={out['sampled_1pct']['req_per_sec']}req/s "
+                      f"(+{out['overhead_sampled_pct']}%) "
+                      f"100%={out['full']['req_per_sec']}req/s "
+                      f"(+{out['overhead_full_pct']}%) "
+                      f"spans={out['full']['spans']} "
+                      f"{'OK' if out['ok'] else 'FAIL'}", file=sys.stderr)
+                print(json.dumps({
+                    "metric": "causal-tracing overhead, gateway 64-client "
+                              "batched leg (1% sampled vs off)" + scale_tag,
+                    "value": out["overhead_sampled_pct"], "unit": "pct",
+                    "vs_baseline": 1.0,
+                    "extra": {"tracing": out, **extra}}))
             elif args.config == "ingest-decode":
                 dec_n = 2048 if args.smoke else 8192
                 dec_leg = 192 if args.smoke else 768

@@ -32,12 +32,20 @@ class FakeClock:
         self.t += dt
 
 
-@pytest.fixture(scope="module")
-def small_region():
+def _fresh_region():
     from akka_tpu.sharding.device import DeviceEntity, DeviceShardRegion
     spec = DeviceEntity("gwb", counter_behavior(4), n_shards=2,
                         entities_per_shard=8, n_devices=2, payload_width=4)
     return DeviceShardRegion(spec)
+
+
+@pytest.fixture(scope="module")
+def small_region():
+    # shared across the file: 16 entity slots total (8/shard, hash-
+    # assigned) — tests that spawn several fresh entity ids should build
+    # their own region via _fresh_region() (same spec shape, so the jit
+    # cache stays warm) instead of eating shared capacity
+    return _fresh_region()
 
 
 def _server(backend, rate=1e6, burst=1e6, clock=None, registry=None):
@@ -305,6 +313,82 @@ def test_binary_json_equivalence_property(small_region):
         _strip_latency(srv_j.slo.artifact())
     assert srv_w.admission.admitted == 8
     assert srv_w.admission.rejected_by_reason == {"rate_limited": 1}
+
+
+def test_traced_replies_id_parity_both_encodings():
+    """ISSUE 12 satellite: with tracing on (100% sampled), EVERY reply —
+    ok, typed error, shed — carries its trace id on BOTH encodings, the
+    reply dicts stay twins modulo the trace values themselves (each
+    server mints its own id stream), and every reply's trace id resolves
+    in that server's span store to a gw.request root with the MATCHING
+    request id — the client-report -> server-trace join the satellite
+    exists for.
+
+    Own region: four fresh entity ids would eat half a shard of the
+    module-shared region's capacity."""
+    from akka_tpu.event.tracing import Tracer
+    region = _fresh_region()
+
+    def mk():
+        tr = Tracer(sample_rate=1.0, seed=11)
+        adm = AdmissionController(rate=0.0, burst=3.0, clock=FakeClock())
+        srv = GatewayServer(None, RegionBackend(region), adm,
+                            SloTracker(), tracer=tr)
+        return srv, tr
+
+    seq = [("t0", "{}-a", "add", 1.0),
+           ("t0", None, "add", 2.0),    # missing entity: typed error
+           ("t0", "{}-a", "get", 0.0), ("t0", "{}-b", "add", 4.0),
+           ("t0", "{}-a", "add", 1.0)]  # bucket (burst 3) empty: shed
+    srv_j, tr_j = mk()
+    srv_b, tr_b = mk()
+    reps_j = [_json_req(srv_j, i, t, e and e.format("trj"), op, v)
+              for i, (t, e, op, v) in enumerate(seq)]
+    reps_b = [_bin_req(srv_b, i, t, e and e.format("trb"), op, v)
+              for i, (t, e, op, v) in enumerate(seq)]
+    assert [r["status"] for r in reps_j] == \
+        [r["status"] for r in reps_b] == \
+        ["ok", "error", "ok", "ok", "shed"]
+    strip = lambda r: {k: v for k, v in r.items() if k != "trace"}
+    assert [strip(r) for r in reps_j] == [strip(r) for r in reps_b]
+    for reps, tr in ((reps_j, tr_j), (reps_b, tr_b)):
+        assert all(r.get("trace") for r in reps)  # ok AND error AND shed
+        roots = {s["trace"]: s for s in tr.of_name("gw.request")}
+        for i, r in enumerate(reps):
+            assert roots[r["trace"]]["id"] == i  # id parity, per reply
+
+
+def test_malformed_frames_traced_on_both_encodings(small_region):
+    """A frame that dies before a request id even exists still gets an
+    anonymous trace: the typed reply carries it and the matching
+    gw.bad_request / gw.bad_frame span is in the store."""
+    from akka_tpu.event.tracing import Tracer
+    tr = Tracer(sample_rate=1.0, seed=2)
+    srv = _server(RegionBackend(small_region))
+    srv._tracer = tr
+    rep = json.loads(srv.handle_frame(b"{not json"))
+    assert rep["status"] == "error" and rep["trace"]
+    assert tr.of_name("gw.bad_request")[0]["trace"] == rep["trace"]
+    rep_b = frames.decode_replies(srv.handle_frame(b"\xab\x01\x00"))[0]
+    assert rep_b["reason"] == "bad_frame:truncated_header"
+    assert rep_b["trace"]
+    assert tr.of_name("gw.bad_frame")[0]["trace"] == rep_b["trace"]
+
+
+def test_untraced_replies_have_no_trace_key():
+    """Tracing off (no tracer): byte-identical version-1 replies, no
+    "trace" key on either encoding — the pre-ISSUE-12 wire, untouched.
+
+    Own region (two fresh entity ids; see small_region's capacity note)."""
+    srv = _server(RegionBackend(_fresh_region()))
+    j = _json_req(srv, 3, "tw", "nt-a", "add", 1.0)
+    body = frames.encode_request_batch([3], ["tw"], ["nt-b"],
+                                       [frames.OP_ADD], [1.0])
+    out = srv.handle_frame(body)
+    assert out[1] == frames.VERSION  # version-1 reply bytes
+    b = frames.decode_replies(out)[0]
+    assert "trace" not in j and "trace" not in b
+    assert "trace" not in frames.decode_reply_batch(out).dtype.names
 
 
 def test_solo_binary_is_json_twin(small_region):

@@ -471,6 +471,38 @@ def on_tpu_found(detail: str) -> None:
                             d.get("json_frames_per_sec"),
                         "decode_speedup": d.get("speedup"),
                         "fullpath_speedup_64": dec.get("speedup_64")})
+    # causal-tracing overhead A/B (ISSUE 12): the gateway 64-client
+    # batched leg with tracing off / 1% sampled / 100% sampled; the
+    # contract row is off-vs-1% (quiet path = one predicate per hook)
+    run_logged("tracing", [sys.executable, "bench.py", "--config",
+                           "tracing-overhead", "--probe-timeout", "120"],
+               timeout_s=1800)
+    tr_out = os.path.join(REPO, "watchdog_tracing.out")
+    if os.path.exists(tr_out):
+        tj = None
+        for line in open(tr_out):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    tj = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
+        trc = (tj or {}).get("extra", {}).get("tracing", {})
+        if trc:
+            append_log({"ts": _utcnow(), "ok": bool(trc.get("ok")),
+                        "detail": "causal-tracing overhead A/B "
+                                  "(off / 1% / 100%, 64 clients)",
+                        "off_req_per_sec":
+                            trc.get("off", {}).get("req_per_sec"),
+                        "sampled_req_per_sec":
+                            trc.get("sampled_1pct", {}).get("req_per_sec"),
+                        "full_req_per_sec":
+                            trc.get("full", {}).get("req_per_sec"),
+                        "overhead_sampled_pct":
+                            trc.get("overhead_sampled_pct"),
+                        "overhead_full_pct": trc.get("overhead_full_pct"),
+                        "spans_full": trc.get("full", {}).get("spans"),
+                        "sampling_working": trc.get("sampling_working")})
     # elastic mesh on-chip: chained live re-shards (2->4->8->4) with the
     # scale-out pause measured against a cold restore of the SAME
     # snapshot (docs/ELASTIC_MESH.md budgets pause <= 2x restore) plus
@@ -512,7 +544,7 @@ def on_tpu_found(detail: str) -> None:
              "watchdog_bridge.out", "watchdog_checkpoint.out",
              "watchdog_metrics.out", "watchdog_failover.out",
              "watchdog_gateway.out", "watchdog_ingest.out",
-             "watchdog_reshard.out"]
+             "watchdog_tracing.out", "watchdog_reshard.out"]
     if last is not None:
         paths.append("BENCH_TPU.json")
     if os.path.isdir(os.path.join(REPO, "traces/tpu_r05")):
