@@ -1,15 +1,18 @@
 """Serving gateway: external traffic in, sharded entities on-device,
 SLOs out (ISSUE 8 tentpole; docs/SERVING_GATEWAY.md).
 
-Three planes, each its own module:
-- ingress:   framed-TCP front door + in-proc transport + RegionBackend
-- admission: per-tenant token buckets + runtime-pressure load shedding
-- slo:       p50/p99 latency vs targets, error budget, per-tenant counters
+Four planes, each its own module:
+- ingress:    framed-TCP front door + in-proc transport + RegionBackend
+- aggregator: cross-connection ingest windows (shared decode/admission/
+              ask waves across sockets)
+- admission:  per-tenant token buckets + runtime-pressure load shedding
+- slo:        p50/p99 latency vs targets, error budget, per-tenant counters
 """
 
 from .admission import (AdmissionController, AskPoolExhausted, Reject,
                         TokenBucket, handle_pressure_signals,
                         region_pressure_signals)
+from .aggregator import IngestAggregator
 from .ingress import (DEFAULT_MAX_FRAME, GatewayClient, GatewayServer,
                       RegionBackend, counter_behavior, encode_body,
                       encode_frame, FrameReader)
@@ -19,6 +22,6 @@ from ..serialization import frames
 __all__ = ["AdmissionController", "AskPoolExhausted", "Reject",
            "TokenBucket", "handle_pressure_signals",
            "region_pressure_signals", "GatewayClient", "GatewayServer",
-           "RegionBackend", "counter_behavior", "encode_body",
-           "encode_frame", "FrameReader", "SloTracker", "frames",
-           "DEFAULT_MAX_FRAME"]
+           "IngestAggregator", "RegionBackend", "counter_behavior",
+           "encode_body", "encode_frame", "FrameReader", "SloTracker",
+           "frames", "DEFAULT_MAX_FRAME"]

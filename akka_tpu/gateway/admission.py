@@ -223,6 +223,55 @@ class AdmissionController:
                     self.rejected_by_reason.get("rate_limited", 0) + (n - k)
         return k, rej
 
+    def admit_groups(self, counts: Dict[str, int]):
+        """Window-level charge for a cross-connection ingest window
+        (ISSUE 13): `counts` maps tenant -> request count; pressure is
+        polled ONCE for the whole window, then each tenant's bucket is
+        charged with one acquire_upto. Returns
+        `{tenant: (k, reject_or_None)}` — per-tenant outcome parity with
+        one admit_batch call per tenant is exact (buckets are
+        independent; the poll is shared, and strictly fewer polls can
+        only see the same-or-fresher signals)."""
+        out: Dict[str, Any] = {}
+        if not counts:
+            return out
+        now = self.clock()
+        with self._lock:
+            if now >= self._next_check and self.pressure_signals:
+                self._poll_pressure(now)
+            if now < self._overload_until:
+                reason = f"overloaded:{self._overload_reason}"
+                rej = Reject(reason, round(self._overload_until - now, 3))
+                for tenant, n in counts.items():
+                    n = int(n)
+                    self.rejected += n
+                    self.rejected_by_reason[reason] = \
+                        self.rejected_by_reason.get(reason, 0) + n
+                    out[tenant] = (0, rej)
+                return out
+            buckets = {}
+            for tenant in counts:
+                bucket = self._buckets.get(tenant)
+                if bucket is None:
+                    bucket = self._buckets[tenant] = TokenBucket(
+                        self.rate, self.burst, self.clock)
+                buckets[tenant] = bucket
+        for tenant, n in counts.items():
+            n = int(n)
+            bucket = buckets[tenant]
+            k = bucket.acquire_upto(n)
+            rej = None if k == n else Reject(
+                "rate_limited", round(bucket.retry_after(), 3))
+            with self._lock:
+                self.admitted += k
+                if k < n:
+                    self.rejected += n - k
+                    self.rejected_by_reason["rate_limited"] = \
+                        self.rejected_by_reason.get("rate_limited", 0) \
+                        + (n - k)
+            out[tenant] = (k, rej)
+        return out
+
     # -------------------------------------------------------------- stats
     def stats(self) -> Dict[str, Any]:
         with self._lock:

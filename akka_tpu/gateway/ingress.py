@@ -65,6 +65,7 @@ always the post-apply total.
 
 from __future__ import annotations
 
+import itertools
 import json
 import socket
 import struct
@@ -76,7 +77,7 @@ import numpy as np
 from ..batched.bridge import AskPoolExhausted
 from ..event.tracing import reset_ctx, set_ctx
 from ..serialization import frames
-from .admission import AdmissionController, Reject
+from .admission import AdmissionController
 from .slo import SloTracker
 
 __all__ = ["encode_frame", "encode_body", "FrameReader", "counter_behavior",
@@ -263,6 +264,34 @@ class RegionBackend:
         return region_pressure_signals(self.region)
 
 
+# -------------------------------------------------- mixed-encoding windows
+# JSON rows that cannot map onto the wire op space get sentinel codes so
+# they flow through the same post-admission typed-error branch their
+# scalar twins used (charged, like any unknown op)
+_OP_JSON_UNKNOWN = 255
+_OP_JSON_BAD_VALUE = 254
+
+_MISSING = object()  # raw_ids sentinel: "id": null must echo null
+
+
+class _WindowAux:
+    """JSON-origin overlays for a mixed-encoding record window: the
+    record columns hold the wire-shaped view (fixed-width bytes, op
+    codes); these per-row maps carry what only JSON can express — raw
+    reply ids, op labels for reasons and span attrs, value-conversion
+    failures, and reasons past the wire's 32-byte truncation."""
+
+    __slots__ = ("json_rows", "raw_ids", "op_labels", "bad_values",
+                 "reasons_full")
+
+    def __init__(self) -> None:
+        self.json_rows: set = set()        # rows decoded from JSON bodies
+        self.raw_ids: Dict[int, Any] = {}      # row -> non-int64 JSON id
+        self.op_labels: Dict[int, str] = {}    # row -> original op string
+        self.bad_values: Dict[int, str] = {}   # row -> typed value reason
+        self.reasons_full: Dict[int, str] = {}  # row -> untruncated reason
+
+
 # ------------------------------------------------------------------- server
 class GatewayServer:
     """The front door: admission -> SLO clock -> backend ask, over TCP
@@ -271,7 +300,9 @@ class GatewayServer:
     def __init__(self, system, backend, admission: AdmissionController,
                  slo: SloTracker, host: str = "127.0.0.1", port: int = 0,
                  max_frame: int = DEFAULT_MAX_FRAME, registry=None,
-                 tracer=None):
+                 tracer=None, aggregate: bool = False,
+                 max_window: int = 64, window_wait_s: float = 150e-6,
+                 pipeline_depth: int = 4):
         self.system = system
         self.backend = backend
         self.admission = admission
@@ -282,6 +313,8 @@ class GatewayServer:
         self._binding = None
         self._seq = 0
         self._registry = registry
+        self.pipeline_depth = int(pipeline_depth)
+        self._conn_ids = itertools.count(1)
         # causal tracing (event/tracing.py): explicit tracer wins, else
         # the system-wired one (akka.tracing.* config); None keeps every
         # hook below at one `is not None` predicate
@@ -299,6 +332,16 @@ class GatewayServer:
             self._h_decode_ns = registry.histogram(
                 "gateway_decode_ns_per_frame",
                 "nanoseconds of wire decode per binary request record")
+        # cross-connection ingest windowing (ISSUE 13): off by default —
+        # the per-frame path below stays bit-identical to the seed
+        self.aggregator = None
+        if aggregate:
+            from .aggregator import IngestAggregator
+            self.aggregator = IngestAggregator(
+                self, max_window=max_window, window_s=window_wait_s,
+                registry=registry)
+            if slo is not None:
+                slo.attach_aggregator(self.aggregator)
 
     # ------------------------------------------------------------ transport
     def start(self) -> Tuple[str, int]:
@@ -312,10 +355,21 @@ class GatewayServer:
         tcp = Tcp.get(self.system)
 
         def handle(conn):
+            stage = Framing.simple_framing_protocol_decoder(self.max_frame)
+            if self.aggregator is not None:
+                # bounded per-connection pipelining: up to pipeline_depth
+                # frames of one socket in flight at the shared aggregator;
+                # MapAsync's ordered drain preserves per-connection reply
+                # order and its in-flight cap keeps the demand chain
+                # intact (a slow consumer still throttles its own socket)
+                cid = next(self._conn_ids)
+                stage = stage.map_async(
+                    self.pipeline_depth,
+                    lambda body, _c=cid: self.aggregator.submit(body, _c))
+            else:
+                stage = stage.map(self.handle_frame)
             conn.handle_with(
-                Framing.simple_framing_protocol_decoder(self.max_frame)
-                .map(self.handle_frame)
-                .via(Framing.simple_framing_protocol_encoder(
+                stage.via(Framing.simple_framing_protocol_encoder(
                     self.max_frame)),
                 self.system)
 
@@ -328,95 +382,32 @@ class GatewayServer:
         if self._binding is not None:
             self._binding.unbind()
             self._binding = None
+        if self.aggregator is not None:
+            self.aggregator.close()
 
     # ------------------------------------------------------------- requests
     def handle_frame(self, frame: bytes) -> bytes:
+        """One frame in, one reply body out. Binary solos keep the
+        zero-copy decode; everything else — including solo JSON — is a
+        one-frame window through the SAME columnar serve path a
+        cross-connection window rides (ISSUE 13: the scalar JSON
+        admission/SLO/trace block is gone, so check-order parity is
+        structural, not mirrored)."""
         if frames.is_binary(frame):
             return self.handle_binary(frame)
-        tr = self._tracer
-        try:
-            req = json.loads(frame)
-            rid = req.get("id", -1)
-            tenant = str(req["tenant"])
-            op = str(req["op"])
-        except Exception as e:  # malformed frame: typed error, keep serving
-            reason = f"bad_request:{type(e).__name__}"
-            trace = tr.start_trace() if tr is not None else 0
-            if trace:  # greppable: the reply's trace id is in the spans
-                t_now = time.monotonic()
-                tr.emit("gw.bad_request", trace, t0=t_now, t1=t_now,
-                        reason=reason, proto="json")
-            return encode_body(self._traced(
-                {"id": -1, "status": "error", "reason": reason}, trace))
-        if tenant == ADMIN_TENANT:
-            return encode_body(self._handle_admin(rid, op, req))
-        # head sampling: ONE decision per trace, made here at ingress
-        trace = tr.start_trace(tenant, rid) if tr is not None else 0
-        if not trace:
-            return encode_body(self._serve_json(rid, tenant, op, req, 0))
-        root = tr.span("gw.request", trace, id=rid, tenant=tenant, op=op,
-                       proto="json")
-        with root:  # sets the ambient ctx: submit() snapshots it
-            rep = self._serve_json(rid, tenant, op, req, trace)
-            root.set(status=rep.get("status"))
-        return encode_body(rep)
+        return self._serve_frames([frame])[0]
 
-    def _serve_json(self, rid, tenant: str, op: str, req: Dict[str, Any],
-                    trace: int) -> Dict[str, Any]:
-        """The JSON serving path behind the root span; every reply is
-        trace-stamped when the request was sampled (ISSUE 12 satellite:
-        a client-reported failure is greppable in the span JSONL)."""
+    def _bad_request_reply(self, e: Exception) -> Dict[str, Any]:
+        """Malformed JSON frame: typed error, keep serving."""
+        reason = f"bad_request:{type(e).__name__}"
         tr = self._tracer
-        if "entity" not in req:
-            # typed BEFORE admission: a malformed frame must not charge
-            # the tenant's token bucket and then surface as fault:KeyError
-            self.slo.record(tenant, "error")
-            return self._traced(
-                {"id": rid, "status": "error",
-                 "reason": "bad_request:missing_entity"}, trace)
-        if trace:
-            with tr.span("gw.admit", trace):
-                rej = self.admission.admit(tenant)
-        else:
-            rej = self.admission.admit(tenant)
-        if rej is not None:
-            self.slo.record(tenant, "reject")
-            return self._traced(self._shed(rid, rej), trace)
-        value = float(req.get("value", 0.0)) if op == "add" else 0.0
-        if op not in ("add", "get"):
-            self.slo.record(tenant, "error")
-            return self._traced({"id": rid, "status": "error",
-                                 "reason": f"unknown_op:{op}"}, trace)
-        t0 = time.perf_counter()
-        try:
-            if trace:
-                with tr.span("gw.ask", trace, entity=str(req["entity"])):
-                    total = self.backend.ask(str(req["entity"]), value)
-            else:
-                total = self.backend.ask(str(req["entity"]), value)
-        except AskPoolExhausted:
-            # the typed fast-fail the admission layer sheds on: convert to
-            # a shed reply AND arm the controller's cooldown
-            self.admission.note_ask_pool_exhausted()
-            self.slo.record(tenant, "reject")
-            return self._traced(self._shed(
-                rid, Reject("ask_pool_exhausted",
-                            self.admission.cooldown_s)), trace)
-        except TimeoutError:
-            self.slo.record(tenant, "timeout",
-                            time.perf_counter() - t0)
-            return self._traced({"id": rid, "status": "error",
-                                 "reason": "timeout"}, trace)
-        except Exception as e:  # noqa: BLE001 — fault isolation per request
-            # latency recorded on the fault leg too (the timeout leg always
-            # did): error-leg p99s stay honest in the SLO artifact
-            self.slo.record(tenant, "error", time.perf_counter() - t0)
-            return self._traced({"id": rid, "status": "error",
-                                 "reason": f"fault:{type(e).__name__}"},
-                                trace)
-        self.slo.record(tenant, "ok", time.perf_counter() - t0)
-        return self._traced({"id": rid, "status": "ok", "value": total},
-                            trace)
+        trace = tr.start_trace() if tr is not None else 0
+        if trace:  # greppable: the reply's trace id is in the spans
+            t_now = time.monotonic()
+            tr.emit("gw.bad_request", trace, t0=t_now, t1=t_now,
+                    reason=reason, proto="json")
+        return self._traced(
+            {"id": -1, "status": "error", "reason": reason}, trace)
 
     @staticmethod
     def _traced(rep: Dict[str, Any], trace: int) -> Dict[str, Any]:
@@ -426,11 +417,6 @@ class GatewayServer:
         if trace:
             rep["trace"] = trace
         return rep
-
-    @staticmethod
-    def _shed(rid, rej: Reject) -> Dict[str, Any]:
-        return {"id": rid, "status": "shed", "reason": rej.reason,
-                "retry_after_ms": int(rej.retry_after_s * 1e3)}
 
     # ------------------------------------------------------ binary requests
     @staticmethod
@@ -460,43 +446,203 @@ class GatewayServer:
         return frames.encode_reply_batch(*cols)
 
     def handle_frame_batch(self, bodies: Sequence[bytes]) -> List[bytes]:
-        """Window entry point for in-proc transports and batched load
-        generators: contiguous BINARY frames in `bodies` merge into one
-        decode pass and ONE ask wave; JSON frames are served one by one
-        (the fallback stays frame-at-a-time). Returns one reply body per
-        input frame, aligned."""
-        out: List[Optional[bytes]] = [None] * len(bodies)
-        i = 0
-        while i < len(bodies):
-            if not frames.is_binary(bodies[i]):
-                out[i] = self.handle_frame(bodies[i])
-                i += 1
+        """Window entry point for the ingest aggregator, in-proc
+        transports and batched load generators: ALL binary frames in
+        `bodies` — contiguous or not — merge into ONE decode pass, JSON
+        frames ride the SAME record columns, and the whole window is one
+        admission charge + one ask wave + one SLO round (ISSUE 13).
+        Admin and malformed frames stay standalone. Returns one reply
+        body per input frame, aligned."""
+        return self._serve_frames(bodies)
+
+    def _bad_frame_reply(self, e: frames.FrameFormatError) -> bytes:
+        """Typed reply for ONE malformed binary frame (keep serving the
+        rest of the window); sampled failures are greppable."""
+        tr = self._tracer
+        trace = tr.start_trace() if tr is not None else 0
+        if trace:  # the bad_frame reply's trace id is in the spans
+            t_now = time.monotonic()
+            tr.emit("gw.bad_frame", trace, t0=t_now, t1=t_now,
+                    reason=f"bad_frame:{e.code}", proto="binary")
+        return self._binary_error(e.code, trace)
+
+    def _serve_frames(self, bodies: Sequence[bytes]) -> List[bytes]:
+        """ONE ingest window across frames of ANY encoding and ANY
+        interleaving (ISSUE 13 tentpole): every valid binary body merges
+        into a single `np.frombuffer` decode, every JSON request lands
+        in the SAME record columns, and the whole window rides one
+        `_serve_records` pass — one vectorized admission charge, one ask
+        wave, one SLO round. Admin and malformed frames are typed
+        standalone (never windowed, never charged). Replies demux back
+        1:1 with `bodies`, each in its own encoding; window row order is
+        arrival order, so per-entity linearization order is frame order
+        (the wave scheduler serves duplicate destinations in row order)."""
+        n_f = len(bodies)
+        out: List[Optional[bytes]] = [None] * n_f
+        bin_idx: List[int] = []     # frame index per valid binary body
+        bin_bodies: List[bytes] = []
+        json_reqs: Dict[int, Dict[str, Any]] = {}  # frame idx -> parsed
+        for f, body in enumerate(bodies):
+            if frames.is_binary(body):
+                try:
+                    frames.check_request_batch(body, self.max_frame)
+                except frames.FrameFormatError as e:
+                    out[f] = self._bad_frame_reply(e)
+                    continue
+                bin_idx.append(f)
+                bin_bodies.append(body)
                 continue
-            # accumulate the contiguous binary run [i, j)
-            j = i
-            spans: List[Tuple[int, int]] = []  # (frame index, n records)
-            recs = []
-            while j < len(bodies) and frames.is_binary(bodies[j]):
-                r = self._decode_window([bodies[j]])
-                if isinstance(r, bytes):
-                    out[j] = r  # typed decode error for THIS frame only
-                else:
-                    spans.append((j, len(r)))
-                    recs.append(r)
-                j += 1
-            if recs:
-                merged = np.concatenate(recs) if len(recs) > 1 else recs[0]
-                ids, st, rsn, val, retry, trc = self._serve_records(merged)
-                lo = 0
-                for idx, n in spans:
-                    hi = lo + n
-                    out[idx] = frames.encode_reply_batch(
-                        ids[lo:hi], st[lo:hi], rsn[lo:hi], val[lo:hi],
-                        retry[lo:hi],
-                        None if trc is None else trc[lo:hi])
-                    lo = hi
-            i = j
+            try:
+                req = json.loads(body)
+                tenant = str(req["tenant"])
+                str(req["op"])  # the scalar path's parse contract
+            except Exception as e:  # malformed: typed, keep serving
+                out[f] = encode_body(self._bad_request_reply(e))
+                continue
+            if tenant == ADMIN_TENANT:
+                out[f] = encode_body(self._handle_admin(
+                    req.get("id", -1), str(req["op"]), req))
+                continue
+            json_reqs[f] = req
+        if not bin_bodies and not json_reqs:
+            return out  # type: ignore[return-value]
+
+        # ---- merged decode: ONE frombuffer for the window's binary rows
+        tr = self._tracer
+        rec_bin = None
+        counts: List[int] = []
+        decode_t = None
+        if bin_bodies:
+            t0d = time.monotonic() if tr is not None else 0.0
+            t0 = time.perf_counter_ns()
+            rec_bin, counts = frames.decode_request_batches(
+                bin_bodies, self.max_frame)
+            if tr is not None:
+                decode_t = (t0d, time.monotonic())
+            if self._h_decode_size is not None and len(rec_bin):
+                dt = time.perf_counter_ns() - t0
+                step = self._registry.step
+                self._h_decode_size.observe(float(len(rec_bin)), step=step)
+                self._h_decode_ns.observe(dt / len(rec_bin), step=step)
+
+        # ---- arrival-order row spans (rows must NOT sort binary-first:
+        # same-entity adds linearize in window row order)
+        count_of = dict(zip(bin_idx, counts))
+        spans: Dict[int, Tuple[int, int]] = {}
+        cursor = 0
+        windowed = sorted(set(count_of) | set(json_reqs))
+        for f in windowed:
+            k = count_of.get(f, 1)
+            spans[f] = (cursor, cursor + k)
+            cursor += k
+        n = cursor
+
+        aux: Optional[_WindowAux] = None
+        if not json_reqs:
+            rec = rec_bin  # pure binary: zero-copy straight through
+        else:
+            rec, aux = self._columnize_mixed(rec_bin, bin_idx, spans,
+                                             json_reqs, n)
+
+        t_serve0 = time.monotonic() if tr is not None else 0.0
+        ids, status, reason, value, retry, traces = \
+            self._serve_records(rec, decode_t, aux)
+
+        if tr is not None and traces is not None and len(windowed) > 1:
+            member = [int(t) for t in traces if t]
+            if member:  # window-level join span, the ask.wave convention
+                tr.emit("gw.ingest_window", member[0], t0=t_serve0,
+                        t1=time.monotonic(), n_frames=len(windowed),
+                        n_records=n, member_traces=member)
+
+        # ---- demux: each frame's reply slice in its own encoding
+        for f in windowed:
+            lo, hi = spans[f]
+            if f in count_of:
+                out[f] = frames.encode_reply_batch(
+                    ids[lo:hi], status[lo:hi], reason[lo:hi],
+                    value[lo:hi], retry[lo:hi],
+                    None if traces is None else traces[lo:hi])
+            else:
+                out[f] = encode_body(self._row_reply(
+                    lo, ids, status, reason, value, retry, traces, aux))
         return out  # type: ignore[return-value]
+
+    @staticmethod
+    def _columnize_mixed(rec_bin, bin_idx: List[int],
+                         spans: Dict[int, Tuple[int, int]],
+                         json_reqs: Dict[int, Dict[str, Any]],
+                         n: int) -> Tuple[np.ndarray, _WindowAux]:
+        """Lower parsed JSON requests into the binary record schema so a
+        mixed window serves as ONE column pass. Tenant/entity columns
+        widen to the window's longest JSON string (the wire's fixed
+        widths are a floor, not a ceiling); binary records scatter into
+        their arrival-order rows with five vectorized field copies."""
+        aux = _WindowAux()
+        tw, ew = frames.TENANT_BYTES, frames.ENTITY_BYTES
+        prep: Dict[int, Tuple[Dict[str, Any], bytes, bytes]] = {}
+        for f, req in json_reqs.items():
+            r = spans[f][0]
+            tb = str(req["tenant"]).encode("utf-8")
+            eb = str(req["entity"]).encode("utf-8") \
+                if "entity" in req else b""
+            tw, ew = max(tw, len(tb)), max(ew, len(eb))
+            prep[r] = (req, tb, eb)
+        rec = np.zeros((n,), np.dtype(
+            [("id", "i8"), ("op", "u1"), ("tenant", f"S{tw}"),
+             ("entity", f"S{ew}"), ("value", "f8")]))
+        if rec_bin is not None and len(rec_bin):
+            rows = np.concatenate([np.arange(*spans[f]) for f in bin_idx])
+            for field in ("id", "op", "tenant", "entity", "value"):
+                rec[field][rows] = rec_bin[field]
+        for r, (req, tb, eb) in prep.items():
+            aux.json_rows.add(r)
+            rid = req.get("id", -1)
+            if type(rid) is int and -(1 << 63) <= rid < (1 << 63):
+                rec["id"][r] = rid
+            else:  # echo non-wire ids (str/float/null/huge) verbatim
+                rec["id"][r] = -1
+                aux.raw_ids[r] = rid
+            rec["tenant"][r] = tb
+            rec["entity"][r] = eb
+            op = str(req["op"])
+            aux.op_labels[r] = op
+            code = frames.OP_CODES.get(op)
+            if code is None:
+                rec["op"][r] = _OP_JSON_UNKNOWN
+                continue
+            rec["op"][r] = code
+            if code == frames.OP_ADD:
+                try:
+                    rec["value"][r] = float(req.get("value", 0.0))
+                except Exception as e:  # typed, not a connection fault
+                    rec["op"][r] = _OP_JSON_BAD_VALUE
+                    aux.bad_values[r] = f"bad_request:{type(e).__name__}"
+        return rec, aux
+
+    @staticmethod
+    def _row_reply(r: int, ids, status, reason, value, retry, traces,
+                   aux: Optional[_WindowAux]) -> Dict[str, Any]:
+        """One window row back to the exact reply dict the scalar JSON
+        path built: per-status key set, raw id echo, untruncated
+        reasons, trace id on sampled replies."""
+        st = int(status[r])
+        rid = aux.raw_ids.get(r, _MISSING) if aux is not None else _MISSING
+        rep: Dict[str, Any] = {
+            "id": int(ids[r]) if rid is _MISSING else rid}
+        if st == frames.ST_OK:
+            rep["status"] = "ok"
+            rep["value"] = float(value[r])
+        else:
+            rep["status"] = "shed" if st == frames.ST_SHED else "error"
+            full = aux.reasons_full.get(r) if aux is not None else None
+            rep["reason"] = full if full is not None else \
+                bytes(reason[r]).rstrip(b"\x00").decode("utf-8", "replace")
+            if st == frames.ST_SHED:
+                rep["retry_after_ms"] = int(retry[r])
+        if traces is not None and int(traces[r]):
+            rep["trace"] = int(traces[r])
+        return rep
 
     def _decode_window(self, bodies: Sequence[bytes]):
         """Decode one or more binary bodies; returns the record array or
@@ -508,13 +654,7 @@ class GatewayServer:
                     for b in bodies]
             rec = np.concatenate(recs) if len(recs) > 1 else recs[0]
         except frames.FrameFormatError as e:
-            tr = self._tracer
-            trace = tr.start_trace() if tr is not None else 0
-            if trace:  # the bad_frame reply's trace id is in the spans
-                t_now = time.monotonic()
-                tr.emit("gw.bad_frame", trace, t0=t_now, t1=t_now,
-                        reason=f"bad_frame:{e.code}", proto="binary")
-            return self._binary_error(e.code, trace)
+            return self._bad_frame_reply(e)
         if self._h_decode_size is not None:
             dt = time.perf_counter_ns() - t0
             step = self._registry.step
@@ -522,15 +662,22 @@ class GatewayServer:
             self._h_decode_ns.observe(dt / len(rec), step=step)
         return rec
 
-    def _serve_records(self, rec: np.ndarray, decode_t=None):
-        """The columnar twin of the JSON request path, one whole window
-        at a time: admin/malformed checks -> vectorized per-tenant
-        admission charge -> ONE ask wave -> vectorized reply columns.
-        Check order mirrors the JSON path exactly (missing entity is
-        typed BEFORE admission and never charges the bucket; unknown op
-        is typed AFTER admission, charged, like JSON); SLO counters are
-        recorded per tenant with `record_many` — counter-identical to N
-        JSON requests.
+    def _serve_records(self, rec: np.ndarray, decode_t=None,
+                       aux: Optional[_WindowAux] = None):
+        """The whole serving path, one record window at a time:
+        admin/malformed checks -> vectorized per-tenant admission charge
+        (ONE pressure poll via admit_groups) -> ONE ask wave ->
+        vectorized reply columns. This is now the ONLY request path —
+        solo JSON is a 1-row window — so check order is a single
+        implementation, not a mirrored pair: missing entity is typed
+        BEFORE admission and never charges the bucket; unknown op (and a
+        JSON "add" whose value fails float()) is typed AFTER admission,
+        charged. SLO counters are recorded per tenant with
+        `record_many` — counter-identical to N scalar requests.
+
+        `aux` (ISSUE 13) carries the JSON overlays of a mixed window:
+        raw reply ids, op-label strings for span attrs and unknown_op
+        reasons, and untruncated reasons for JSON replies.
 
         Tracing (ISSUE 12): each record gets its own head-sampled trace
         at ingress (one window holds MANY traces); sampled records get a
@@ -554,14 +701,20 @@ class GatewayServer:
         if tr is not None:
             traces = np.zeros((n,), np.uint64)
             for i in range(n):
+                is_json = aux is not None and i in aux.json_rows
+                rid: Any = aux.raw_ids.get(i, _MISSING) if is_json \
+                    else _MISSING
+                if rid is _MISSING:
+                    rid = int(ids[i])
                 tid = tr.start_trace(
-                    tenants[i].decode("utf-8", "replace"), int(ids[i]))
+                    tenants[i].decode("utf-8", "replace"), rid)
                 if tid:
                     traces[i] = tid
                     roots[i] = tr.begin(
-                        "gw.request", tid, id=int(ids[i]),
+                        "gw.request", tid, id=rid,
                         tenant=tenants[i].decode("utf-8", "replace"),
-                        op=int(ops[i]), proto="binary")
+                        op=(aux.op_labels[i] if is_json else int(ops[i])),
+                        proto="json" if is_json else "binary")
             if roots and decode_t is not None:
                 # the window's decode, retro-emitted under the first
                 # sampled root (one decode serves many traces — the
@@ -584,7 +737,18 @@ class GatewayServer:
             slo_outcomes.setdefault(t, []).extend([outcome] * count)
             slo_lat.setdefault(t, []).extend([lat] * count)
 
-        # ---- vectorized per-tenant admission charge (one debit/tenant)
+        def set_reason(i, full: str) -> None:
+            # wire truncation on the column; JSON replies keep the full
+            # string through the aux overlay (the scalar path never
+            # truncated, so neither does its windowed twin)
+            b = full.encode("utf-8")
+            reason[i] = b[:frames.REASON_BYTES]
+            if (aux is not None and len(b) > frames.REASON_BYTES
+                    and i in aux.json_rows):
+                aux.reasons_full[int(i)] = full
+
+        # ---- vectorized per-tenant admission charge: ONE pressure poll
+        # for the whole window, one bucket debit per tenant
         aspan = None
         if roots:  # one admit_batch span joined to the rest by traces
             aspan = tr.begin("gw.admit_batch",
@@ -592,9 +756,14 @@ class GatewayServer:
                              member_traces=[s.trace_id
                                             for s in roots.values()])
         admitted = np.zeros((n,), bool)
-        for t in np.unique(tenants[eligible]) if eligible.any() else ():
-            rows = np.nonzero(eligible & (tenants == t))[0]
-            k, rej = self.admission.admit_batch(t.decode("utf-8"), len(rows))
+        groups: Dict[bytes, np.ndarray] = {}
+        if eligible.any():
+            for t in np.unique(tenants[eligible]):
+                groups[t] = np.nonzero(eligible & (tenants == t))[0]
+        verdicts = self.admission.admit_groups(
+            {t.decode("utf-8"): len(rows) for t, rows in groups.items()})
+        for t, rows in groups.items():
+            k, rej = verdicts[t.decode("utf-8")]
             admitted[rows[:k]] = True
             if rej is not None:
                 shed = rows[k:]
@@ -606,12 +775,16 @@ class GatewayServer:
         if aspan is not None:
             aspan.finish(admitted=int(admitted.sum()))
 
-        # unknown-op is typed AFTER admission (the JSON path charges the
-        # bucket before it inspects the op)
+        # unknown-op is typed AFTER admission (the scalar path charged
+        # the bucket before it inspected the op); JSON sentinel rows
+        # (unmappable op string, bad "add" value) ride the same branch
         known = np.isin(ops, (frames.OP_GET, frames.OP_ADD))
         for i in np.nonzero(admitted & ~known)[0]:
-            reason[i] = f"unknown_op:{int(ops[i])}".encode("utf-8") \
-                [:frames.REASON_BYTES]
+            full = aux.bad_values.get(i) if aux is not None else None
+            if full is None:
+                lbl = aux.op_labels.get(i) if aux is not None else None
+                full = f"unknown_op:{lbl if lbl is not None else int(ops[i])}"
+            set_reason(i, full)
             note(tenants[i], "error")
         for i in np.nonzero(missing)[0]:
             note(tenants[i], "error")
@@ -644,8 +817,7 @@ class GatewayServer:
                     reason[i] = b"timeout"
                     note(t, "timeout", dt)
                 elif isinstance(outc, BaseException):
-                    reason[i] = f"fault:{type(outc).__name__}" \
-                        .encode("utf-8")[:frames.REASON_BYTES]
+                    set_reason(i, f"fault:{type(outc).__name__}")
                     note(t, "error", dt)
                 else:
                     status[i] = frames.ST_OK
@@ -658,10 +830,12 @@ class GatewayServer:
             st_names = {frames.ST_OK: "ok", frames.ST_SHED: "shed",
                         frames.ST_ERROR: "error"}
             for i, sp in roots.items():
-                rsn = bytes(reason[i]).rstrip(b"\x00")
+                full = aux.reasons_full.get(i) if aux is not None else None
+                rsn = full if full is not None else \
+                    bytes(reason[i]).rstrip(b"\x00") \
+                    .decode("utf-8", "replace")
                 sp.finish(status=st_names.get(int(status[i]), "error"),
-                          **({"reason": rsn.decode("utf-8", "replace")}
-                             if rsn else {}))
+                          **({"reason": rsn} if rsn else {}))
         return ids, status, reason, value, retry, traces
 
     def _backend_ask_many(self, entity_ids: List[str],
@@ -804,6 +978,56 @@ class GatewayClient:
                        value: float = 0.0) -> Dict[str, Any]:
         """Solo binary ask — the JSON `request`'s bit-identical twin."""
         return self.request_many([(tenant, entity, op, value)])[0]
+
+    def request_many_pipelined(
+            self, windows: Sequence[Sequence[Tuple[str, str, str, float]]],
+            depth: int = 4) -> List[List[Dict[str, Any]]]:
+        """Depth-k pipelined binary windows (ISSUE 13): up to `depth`
+        window frames outstanding on the connection before the first
+        reply is read — the client-side load shape that actually fills
+        the server's cross-connection ingest windows. Replies come back
+        in order (the server's per-connection FIFO contract) and each is
+        matched to its window by the first record's sequence id; a
+        mismatch raises. Returns one reply list per input window,
+        aligned."""
+        if self._sock is None:
+            self.connect()
+        depth = max(1, int(depth))
+        encoded: List[bytes] = []
+        first_ids: List[int] = []
+        for win in windows:
+            if not win:
+                raise ValueError("empty window in pipelined request")
+            ids, tenants, entities, ops, values = [], [], [], [], []
+            for tenant, entity, op, val in win:
+                self._seq += 1
+                ids.append(self._seq)
+                tenants.append(tenant)
+                entities.append(entity)
+                ops.append(op)
+                values.append(float(val))
+            encoded.append(frames.frame(frames.encode_request_batch(
+                ids, tenants, entities, ops, values)))
+            first_ids.append(ids[0])
+        out: List[List[Dict[str, Any]]] = []
+        sent = 0
+        while len(out) < len(encoded):
+            while sent < len(encoded) and sent - len(out) < depth:
+                self._sock.sendall(encoded[sent])
+                sent += 1
+            data = self._sock.recv(65536)
+            if not data:
+                raise ConnectionError("gateway closed the connection")
+            for body in self._reader.feed_raw(data):
+                reps = frames.decode_replies(body, self.max_frame)
+                want = first_ids[len(out)]
+                got = reps[0]["id"]
+                if got != want:
+                    raise ValueError(
+                        f"pipelined reply out of order: got first id "
+                        f"{got}, want {want}")
+                out.append(reps)
+        return out
 
     def request_retry(self, tenant: str, entity: str, op: str,
                       value: float = 0.0, deadline_s: float = 60.0,

@@ -61,6 +61,7 @@ class SloTracker:
         self._lat_sorted = (-1, [])
         self._hist = None
         self._batcher = None
+        self._aggregator = None
         self._autoscaler = None
         if registry is not None:
             registry.register_collector("gateway", self._collect)
@@ -76,6 +77,16 @@ class SloTracker:
         histograms live on the MetricsRegistry; this is the stable-schema
         summary next to the latency numbers it explains."""
         self._batcher = batcher
+
+    def attach_aggregator(self, aggregator) -> None:
+        """Carry the cross-connection ingest summary
+        (IngestAggregator.stats: windows, frames, mean_window_size, ...)
+        in artifact() as `ingest_window`, next to `ask_batch` — the two
+        coalescing layers an operator reads together: how many frames
+        shared one decode/admission round, and how many asks shared one
+        device round. Size/wait histograms live on the MetricsRegistry
+        (docs/OBSERVABILITY.md); this is the stable-schema summary."""
+        self._aggregator = aggregator
 
     def attach_autoscaler(self, autoscaler) -> None:
         """Carry the elastic-mesh summary (MeshAutoscaler.stats: widened/
@@ -157,10 +168,13 @@ class SloTracker:
         step = self.registry.step if self.registry is not None else 0
         batch = ({"ask_batch": self._batcher.stats()}
                  if self._batcher is not None else {})
+        ingest = ({"ingest_window": self._aggregator.stats()}
+                  if self._aggregator is not None else {})
         scale = ({"autoscale": self._autoscaler.stats()}
                  if self._autoscaler is not None else {})
         return {
             **batch,
+            **ingest,
             **scale,
             "requests": total,
             "ok": counts["ok"],

@@ -68,6 +68,7 @@ __all__ = ["MAGIC", "VERSION", "VERSION_TRACED", "KIND_REQUEST",
            "DEFAULT_MAX_FRAME",
            "FrameFormatError", "is_binary", "frame",
            "encode_request_batch", "decode_request_batch",
+           "check_request_batch", "decode_request_batches",
            "encode_reply_batch", "decode_reply_batch", "reply_to_dict",
            "decode_replies"]
 
@@ -172,8 +173,12 @@ def encode_request_batch(ids: Sequence[int], tenants: Sequence[Any],
     return _header(KIND_REQUEST, n) + rec.tobytes()
 
 
-def _decode_records(body: bytes, kind: int, dtype: np.dtype,
-                    max_frame: int, version: int = VERSION) -> np.ndarray:
+def _check_records(body: bytes, kind: int, dtype: np.dtype,
+                   max_frame: int, version: int = VERSION) -> int:
+    """Validate one frame body's header/length; returns the record
+    count. Split from the decode so a cross-connection window can
+    validate EVERY body first and then reinterpret all record bytes in
+    one pass (decode_request_batches)."""
     if len(body) > max_frame:
         raise FrameFormatError("oversize",
                                f"{len(body)} bytes exceeds {max_frame}")
@@ -195,6 +200,12 @@ def _decode_records(body: bytes, kind: int, dtype: np.dtype,
             "bad_length", f"{n} records need {expect} bytes, got {len(body)}")
     if n == 0:
         raise FrameFormatError("empty_batch")
+    return n
+
+
+def _decode_records(body: bytes, kind: int, dtype: np.dtype,
+                    max_frame: int, version: int = VERSION) -> np.ndarray:
+    n = _check_records(body, kind, dtype, max_frame, version)
     # THE batch decode: one zero-copy reinterpret of the whole window
     return np.frombuffer(body, dtype, count=n, offset=_HEADER.itemsize)
 
@@ -205,6 +216,32 @@ def decode_request_batch(body: bytes,
     rec["op"], rec["entity"], rec["value"], ... are numpy columns).
     Raises FrameFormatError with a typed code for malformed frames."""
     return _decode_records(body, KIND_REQUEST, REQUEST_DTYPE, max_frame)
+
+
+def check_request_batch(body: bytes,
+                        max_frame: int = DEFAULT_MAX_FRAME) -> int:
+    """Validate a request body without decoding; returns its record
+    count (the aggregator's window-close unit). Raises FrameFormatError
+    with the same typed codes as decode_request_batch."""
+    return _check_records(body, KIND_REQUEST, REQUEST_DTYPE, max_frame)
+
+
+def decode_request_batches(bodies: Sequence[bytes],
+                           max_frame: int = DEFAULT_MAX_FRAME):
+    """Merged window decode (ISSUE 13): many frame bodies — from many
+    connections — validated individually, then ALL their record bytes
+    reinterpreted in ONE `np.frombuffer`. Returns `(rec, counts)` where
+    `counts[i]` is body i's record count (the demux offsets). A single
+    body keeps the zero-copy solo path; callers wanting per-body typed
+    errors should pre-filter with check_request_batch."""
+    counts = [_check_records(b, KIND_REQUEST, REQUEST_DTYPE, max_frame)
+              for b in bodies]
+    if len(bodies) == 1:
+        return (np.frombuffer(bodies[0], REQUEST_DTYPE, count=counts[0],
+                              offset=_HEADER.itemsize), counts)
+    payload = b"".join(bytes(memoryview(b)[_HEADER.itemsize:])
+                       for b in bodies)
+    return np.frombuffer(payload, REQUEST_DTYPE), counts
 
 
 # ------------------------------------------------------------------- replies

@@ -43,7 +43,24 @@ import numpy as np
 
 from ..event.tracing import NOOP_SPAN, current_ctx, reset_ctx, set_ctx
 
-__all__ = ["BatchAsk", "execute_ask_batch", "AskBatcher"]
+__all__ = ["BatchAsk", "execute_ask_batch", "AskBatcher",
+           "wait_adaptive_close"]
+
+
+def wait_adaptive_close(work: threading.Event, window_s: float,
+                        full) -> None:
+    """THE adaptive window-close wait, shared by the ask dispatcher and
+    the ingest aggregator (gateway/aggregator.py): block until `full()`
+    says the window is worth closing or `window_s` has elapsed since the
+    window opened — whichever first — waking early whenever `work` is
+    set by a new arrival. `full` must take its own lock."""
+    deadline = time.perf_counter() + window_s
+    while not full():
+        remain = deadline - time.perf_counter()
+        if remain <= 0:
+            return
+        work.wait(remain)
+        work.clear()
 
 
 class BatchAsk:
@@ -416,6 +433,10 @@ class AskBatcher:
         return [a.outcome for a in batch]
 
     # ---------------------------------------------------------- dispatcher
+    def _full(self) -> bool:
+        with self._lock:
+            return len(self._pending) >= self.max_batch
+
     def _loop(self) -> None:
         while True:
             self._work.wait(0.25)
@@ -429,16 +450,7 @@ class AskBatcher:
                         break
                 # adaptive window: wait for the batch to fill, close on
                 # max_batch pending or window_s elapsed, whichever first
-                deadline = time.perf_counter() + self.window_s
-                while True:
-                    with self._lock:
-                        if len(self._pending) >= self.max_batch:
-                            break
-                    remain = deadline - time.perf_counter()
-                    if remain <= 0:
-                        break
-                    self._work.wait(remain)
-                    self._work.clear()
+                wait_adaptive_close(self._work, self.window_s, self._full)
                 with self._lock:
                     close_batch = self._pending[:self.max_batch]
                     del self._pending[:self.max_batch]

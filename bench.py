@@ -1305,6 +1305,117 @@ def bench_gateway_binary_ab(region, per_leg: int = 384, window: int = 16):
             "ok": speedup >= 2.0}
 
 
+def bench_gateway_ingest_ab(region, per_leg: int = 384):
+    """Cross-connection ingest windowing A/B (ISSUE 13 acceptance): the
+    same solo-frame load through the gateway with the IngestAggregator
+    on vs off, equal admission (wide open both ways) on one shared warm
+    region. Two mixes:
+
+    - json: 64 clients, each a stream of solo JSON frames — the worst
+      case for per-frame serving (one decode + one admission poll + one
+      SLO lock per request) and the best case for windowing (concurrency
+      alone builds multi-frame windows).
+    - mixed: 32 JSON clients + 32 binary clients (8-record window
+      frames) — mixed encodings riding ONE window's record columns.
+
+    The acceptance bar is aggregated JSON >= 2x per-frame req/s with
+    mean_window_size > 1 (real coalescing, not a timer tax); rows are
+    host-stamped like every gateway bench row."""
+    import threading as _threading
+
+    from akka_tpu.gateway import (AdmissionController, GatewayServer,
+                                  RegionBackend, SloTracker)
+    from akka_tpu.serialization import frames as _frames
+
+    clients = 64
+    per_client = max(8, per_leg // clients)
+    per_client -= per_client % 8  # whole binary windows in the mixed mix
+    bin_window = 8
+
+    def leg(mix: str, aggregated: bool):
+        backend = RegionBackend(region, max_batch=64)
+        slo = SloTracker(target_p50_ms=50.0, target_p99_ms=250.0)
+        adm = AdmissionController(rate=1e9, burst=1e9)
+        srv = GatewayServer(None, backend, adm, slo,
+                            aggregate=aggregated, max_window=64,
+                            window_wait_s=200e-6)
+        serve = ((lambda body, c: srv.aggregator
+                  .submit(body, c).result(30.0)) if aggregated
+                 else (lambda body, c: srv.handle_frame(body)))
+        not_ok = []
+
+        def worker(w: int):
+            # same 48-entity contention set as the encoding A/B
+            reqs = [(f"t{w % 4}", f"ab-{(w * bin_window + i) % 48}",
+                     "add" if i % 4 else "get", float(i % 5 + 1))
+                    for i in range(per_client)]
+            binary = mix == "mixed" and w % 2 == 0
+            if binary:
+                for lo in range(0, per_client, bin_window):
+                    chunk = reqs[lo:lo + bin_window]
+                    body = _frames.encode_request_batch(
+                        list(range(lo, lo + len(chunk))),
+                        [r[0] for r in chunk], [r[1] for r in chunk],
+                        [r[2] for r in chunk], [r[3] for r in chunk])
+                    for rep in _frames.decode_replies(serve(body, w)):
+                        if rep["status"] != "ok":
+                            not_ok.append(rep["status"])
+            else:
+                for i, (t, e, op, v) in enumerate(reqs):
+                    rep = json.loads(serve(json.dumps(
+                        {"id": i, "tenant": t, "entity": e, "op": op,
+                         "value": v}).encode(), w))
+                    if rep["status"] != "ok":
+                        not_ok.append(rep["status"])
+
+        threads = [_threading.Thread(target=worker, args=(w,))
+                   for w in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        n = per_client * clients
+        art = slo.artifact()
+        row = {"mix": mix,
+               "aggregated": aggregated, "clients": clients,
+               "requests": n, "wall_s": round(dt, 3),
+               "req_per_sec": round(n / dt, 1), "not_ok": len(not_ok),
+               "admitted": adm.admitted, "rejected": adm.rejected,
+               "p50_ms": art["p50_ms"], "p99_ms": art["p99_ms"]}
+        if aggregated:
+            st = srv.aggregator.stats()
+            srv.aggregator.close()
+            row["mean_window_size"] = round(st["mean_window_size"], 2)
+            row["mean_frames_per_window"] = round(
+                st["mean_frames_per_window"], 2)
+            row["multi_frame_windows"] = int(st["multi_frame_windows"])
+        try:
+            row["host_loadavg"] = round(os.getloadavg()[0], 2)
+        except OSError:
+            pass
+        backend.close()
+        return row
+
+    legs = {}
+    for mix in ("json", "mixed"):
+        off, on = leg(mix, False), leg(mix, True)
+        legs[mix] = {
+            "per_frame": off, "aggregated": on,
+            "speedup": round(on["req_per_sec"]
+                             / max(off["req_per_sec"], 1e-9), 2),
+            "equal_admission": (off["admitted"] == on["admitted"]
+                                and off["rejected"] == on["rejected"]
+                                == 0)}
+    j = legs["json"]
+    return {**legs,
+            "speedup": j["speedup"],
+            "mean_window_size": j["aggregated"]["mean_window_size"],
+            "ok": (j["speedup"] >= 2.0
+                   and j["aggregated"]["mean_window_size"] > 1.0)}
+
+
 def bench_tracing_overhead(region, per_leg: int = 384):
     """tracing-overhead (ISSUE 12): the gateway 64-client batched leg
     (same mix as bench_gateway_concurrency) run three ways on one shared
@@ -1584,11 +1695,13 @@ def bench_gateway_slo(n_requests: int = 400, n_entities: int = 16):
     backend.close()
     concurrency = bench_gateway_concurrency(region)
     binary_ab = bench_gateway_binary_ab(region, per_leg=n_requests)
+    ingest_ab = bench_gateway_ingest_ab(region, per_leg=n_requests)
     return {"below_threshold": below, "overload": over,
             "entities_total": round(total, 1),
             "shed_working": over["rejects"] > 0 and below["rejects"] == 0,
             "concurrency": concurrency,
-            "binary_ab": binary_ab}
+            "binary_ab": binary_ab,
+            "ingest_ab": ingest_ab}
 
 
 def main() -> None:
@@ -1906,12 +2019,16 @@ def main() -> None:
                 out = bench_gateway_slo(gw_n)
                 b, o = out["below_threshold"], out["overload"]
                 ab = out["binary_ab"]
+                ia = out["ingest_ab"]
                 print(f"[bench] gateway-slo: p50={b['p50_ms']}ms "
                       f"p99={b['p99_ms']}ms @{b['req_per_sec']}req/s | "
                       f"overload reject_rate={o['reject_rate']} "
                       f"shed={'OK' if out['shed_working'] else 'FAIL'} | "
                       f"binary x{ab['speedup']} "
-                      f"{'OK' if ab['ok'] else 'FAIL'}",
+                      f"{'OK' if ab['ok'] else 'FAIL'} | "
+                      f"ingest x{ia['speedup']} "
+                      f"win={ia['mean_window_size']} "
+                      f"{'OK' if ia['ok'] else 'FAIL'}",
                       file=sys.stderr)
                 print(json.dumps({
                     "metric": "gateway serving latency p99, sustained load "

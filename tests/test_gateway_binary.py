@@ -433,6 +433,35 @@ def test_handle_frame_batch_merges_and_aligns(small_region):
     assert json.loads(outs[3]) == {"id": 4, "status": "ok", "value": 2.0}
 
 
+def test_handle_frame_batch_merges_noncontiguous_binaries():
+    """ISSUE 13 satellite: binary frames separated by JSON frames in one
+    window still merge into a SINGLE frombuffer decode (one histogram
+    observe covering every binary record), and the JSON frame rides the
+    same serve pass — one admission poll, aligned replies."""
+    from akka_tpu.event.metrics import MetricsRegistry
+
+    class OkBackend:
+        def ask(self, entity_id, value):
+            return 7.0 + value
+
+    reg = MetricsRegistry()
+    reg.set_step(9)
+    srv = _server(OkBackend(), registry=reg)
+    b1 = frames.encode_request_batch([1, 2], ["t0"] * 2, ["nc-a", "nc-b"],
+                                     [frames.OP_ADD] * 2, [1.0, 2.0])
+    js = encode_body({"id": 3, "tenant": "t0", "entity": "nc-c",
+                      "op": "get"})
+    b2 = frames.encode_request_batch([4], ["t0"], ["nc-d"],
+                                     [frames.OP_GET], [0.0])
+    outs = srv.handle_frame_batch([b1, js, b2])
+    assert [r["value"] for r in frames.decode_replies(outs[0])] \
+        == [8.0, 9.0]
+    assert json.loads(outs[1]) == {"id": 3, "status": "ok", "value": 7.0}
+    assert frames.decode_replies(outs[2])[0]["value"] == 7.0
+    size = reg.histogram("gateway_decode_batch_size").snapshot()
+    assert size["count"] == 1 and size["sum"] == 3.0 and size["step"] == 9
+
+
 # -------------------------------------------------------------- decode metrics
 def test_decode_metrics_histograms_step_stamped():
     from akka_tpu.event.metrics import MetricsRegistry

@@ -1,0 +1,366 @@
+"""Cross-connection ingest windowing (gateway/aggregator.py +
+ingress._serve_frames, ISSUE 13): frames from many sockets share one
+decode/admission/ask wave, replies demux per connection in FIFO order.
+
+Tier-1 scope: fake backends everywhere except the two equivalence tests,
+which ride fresh regions of the SAME spec shape as test_gateway_binary's
+("gwb": 2 shards x 8 entities, 2 devices, payload width 4 — the jit
+cache stays warm); windows stay <= 64 rows."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from akka_tpu.gateway import (AdmissionController, GatewayClient,
+                              GatewayServer, IngestAggregator,
+                              RegionBackend, SloTracker, counter_behavior)
+from akka_tpu.gateway.ingress import encode_body
+from akka_tpu.serialization import frames
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class OkBackend:
+    """ask-only backend (no ask_many): exercises the fallback per-ask
+    loop under the windowed path."""
+
+    def ask(self, entity_id, value):
+        return 42.0 + value
+
+
+def _fresh_region():
+    from akka_tpu.sharding.device import DeviceEntity, DeviceShardRegion
+    spec = DeviceEntity("gwb", counter_behavior(4), n_shards=2,
+                        entities_per_shard=8, n_devices=2, payload_width=4)
+    return DeviceShardRegion(spec)
+
+
+def _server(backend, rate=1e6, burst=1e6, clock=None, registry=None,
+            **kw):
+    adm = AdmissionController(rate=rate, burst=burst,
+                              **({"clock": clock} if clock else {}))
+    return GatewayServer(None, backend, adm, SloTracker(registry=registry),
+                         registry=registry, **kw)
+
+
+def _json_body(i, tenant, entity, op, value=0.0):
+    req = {"id": i, "tenant": tenant, "op": op, "value": value}
+    if entity is not None:
+        req["entity"] = entity
+    return encode_body(req)
+
+
+# -------------------------------------------------------------- aggregation
+def test_concurrent_frames_share_one_window():
+    """Frames submitted concurrently from many 'connections' coalesce:
+    fewer windows than frames, every reply correct and FIFO per conn."""
+    srv = _server(OkBackend())
+    agg = IngestAggregator(srv, max_window=16, window_s=50e-3)
+    try:
+        n = 16
+        barrier = threading.Barrier(n)
+        out = [None] * n
+
+        def client(i):
+            barrier.wait()
+            fut = agg.submit(_json_body(i, "t0", f"cw-{i}", "add",
+                                        float(i)), conn_id=i)
+            out[i] = json.loads(fut.result(10.0))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, rep in enumerate(out):
+            assert rep == {"id": i, "status": "ok", "value": 42.0 + i}
+        st = agg.stats()
+        assert st["frames"] == n and st["records"] == n
+        assert st["windows"] < n  # coalescing actually happened
+        assert st["mean_window_size"] > 1.0
+        assert st["multi_frame_windows"] >= 1.0
+        assert st["pending"] == 0.0
+    finally:
+        agg.close()
+
+
+def test_deadline_flush_bounds_solo_latency():
+    """A lone frame under light load is NOT stuck waiting for a full
+    window: the adaptive deadline flushes it."""
+    srv = _server(OkBackend())
+    agg = IngestAggregator(srv, max_window=64, window_s=2e-3)
+    try:
+        t0 = time.perf_counter()
+        rep = json.loads(agg.submit(
+            _json_body(1, "t0", "solo", "get")).result(10.0))
+        dt = time.perf_counter() - t0
+        assert rep["status"] == "ok"
+        assert dt < 1.0  # deadline-close, not max_window-close
+        st = agg.stats()
+        assert st["windows"] == 1.0 and st["records"] == 1.0
+    finally:
+        agg.close()
+
+
+def test_close_flushes_pending_frames():
+    """close() is a drain, not a drop: every frame submitted before
+    close() resolves with a SERVED reply, and submit() after close()
+    raises."""
+    srv = _server(OkBackend())
+    # huge window + long deadline: frames are pending when close() runs
+    agg = IngestAggregator(srv, max_window=1024, window_s=30.0)
+    futs = [agg.submit(_json_body(i, "t0", f"cf-{i}", "get"), conn_id=i)
+            for i in range(6)]
+    agg.close()
+    for i, fut in enumerate(futs):
+        rep = json.loads(fut.result(1.0))  # resolved, not stranded
+        assert rep == {"id": i, "status": "ok", "value": 42.0}
+    with pytest.raises(RuntimeError):
+        agg.submit(_json_body(9, "t0", "cf-late", "get"))
+    agg.close()  # idempotent
+
+
+def test_aggregated_solo_is_per_frame_twin():
+    """Aggregator-off acceptance: a frame through the aggregator (window
+    of one) returns byte-identical replies to the same frame through the
+    per-frame path — the window path IS the serving path."""
+    srv_a = _server(OkBackend())
+    srv_b = _server(OkBackend())
+    agg = IngestAggregator(srv_a, max_window=8, window_s=1e-4)
+    try:
+        bodies = [
+            _json_body(1, "t0", "tw-a", "add", 2.5),
+            frames.encode_request_batch([2], ["t0"], ["tw-b"], ["get"],
+                                        [0.0]),
+            _json_body(3, "t0", None, "add", 1.0),   # missing entity
+            _json_body(4, "t0", "tw-a", "nope"),     # unknown op
+            b"\xab\x01",                             # malformed binary
+            b"{broken",                              # malformed JSON
+        ]
+        for body in bodies:
+            via_agg = agg.submit(body).result(10.0)
+            assert via_agg == srv_b.handle_frame(body)
+    finally:
+        agg.close()
+
+
+# ------------------------------------------------------- window equivalence
+def test_mixed_window_equivalent_to_per_frame(small_region_pair):
+    """THE windowed-equivalence contract: one mixed-encoding window
+    (JSON and binary interleaved, same-entity adds, a shed, typed
+    errors) through `handle_frame_batch` produces the same decoded
+    replies, SLO counters and admission counters as the identical
+    sequence served frame-at-a-time."""
+    region_a, region_b = small_region_pair
+    mk = lambda r: _server(RegionBackend(r), rate=0.0, burst=6.0,
+                           clock=FakeClock())
+    srv_solo, srv_win = mk(region_a), mk(region_b)
+
+    def bodies(tag):
+        bin1 = frames.encode_request_batch(
+            [0, 1], ["t0", "t0"], [f"{tag}-a", f"{tag}-a"],
+            ["add", "add"], [1.0, 2.0])       # same entity: linearizes
+        js1 = _json_body(2, "t0", f"{tag}-a", "get")
+        js2 = _json_body(3, "t0", None, "add", 9.0)   # missing: uncharged
+        bin2 = frames.encode_request_batch(
+            [4], ["t1"], [f"{tag}-b"], ["add"], [4.0])
+        js3 = _json_body(5, "t0", f"{tag}-b", "bogus")  # unknown: charged
+        js4 = _json_body(6, "t0", f"{tag}-a", "add", 1.0)
+        js5 = _json_body(7, "t0", f"{tag}-a", "get")
+        js6 = _json_body(8, "t0", f"{tag}-a", "add", 1.0)  # bucket empty
+        return [bin1, js1, js2, bin2, js3, js4, js5, js6]
+
+    def decode(outs):
+        reps = []
+        for body in outs:
+            if frames.is_binary(body):
+                reps.extend(frames.decode_replies(body))
+            else:
+                reps.append(json.loads(body))
+        return reps
+
+    reps_solo = decode([srv_solo.handle_frame(b) for b in bodies("fs")])
+    reps_win = decode(srv_win.handle_frame_batch(bodies("fw")))
+    assert reps_win == reps_solo
+    assert [r["status"] for r in reps_win] == \
+        ["ok", "ok", "ok", "error", "ok", "error", "ok", "ok", "shed"]
+    # same-entity adds linearized in window row order on both paths
+    assert [r["value"] for r in reps_win[:3]] == [1.0, 3.0, 3.0]
+    assert reps_win[5]["reason"] == "unknown_op:bogus"
+
+    def strip(art):
+        for k in ("p50_ms", "p99_ms", "p50_met", "p99_met"):
+            art.pop(k)
+        return art
+
+    assert strip(srv_win.slo.artifact()) == strip(srv_solo.slo.artifact())
+    for a in (srv_solo.admission, srv_win.admission):
+        # t0: 7 charges (unknown-op charged, missing-entity NOT) against
+        # burst 6 -> 6 admitted + 1 shed; t1: 1 admitted
+        assert a.admitted == 7
+        assert a.rejected_by_reason == {"rate_limited": 1}
+
+
+@pytest.fixture()
+def small_region_pair():
+    # two fresh regions of the warm "gwb" spec shape: solo and windowed
+    # servers must start from identical (zero) entity state
+    return _fresh_region(), _fresh_region()
+
+
+# ---------------------------------------------------------------- tracing
+def test_multi_root_window_trace_tree_integrity():
+    """One mixed window holds MANY traces: every record keeps its own
+    gw.request root (id/proto/op attrs preserved per encoding), the
+    admit_batch and ingest-window join spans carry member_traces, and no
+    span references a parent that was never emitted."""
+    from akka_tpu.event.tracing import Tracer
+    tr = Tracer(sample_rate=1.0, seed=5)
+    srv = _server(OkBackend())
+    srv._tracer = tr
+    bodies = [
+        frames.encode_request_batch([0, 1], ["t0", "t1"],
+                                    ["mr-a", "mr-b"], ["add", "get"],
+                                    [1.0, 0.0]),
+        _json_body(2, "t0", "mr-c", "add", 3.0),
+        _json_body("rid-x", "t1", "mr-d", "get"),  # non-int id echoes
+    ]
+    outs = srv.handle_frame_batch(bodies)
+    bin_reps = frames.decode_replies(outs[0])
+    js1, js2 = json.loads(outs[1]), json.loads(outs[2])
+    assert js2["id"] == "rid-x"
+    spans = tr.spans()
+    by_id = {(s["trace"], s["span"]): s for s in spans}
+    for s in spans:
+        if s["parent"]:
+            assert (s["trace"], s["parent"]) in by_id, f"orphan: {s}"
+    roots = {s["trace"]: s for s in spans if s["name"] == "gw.request"}
+    assert len(roots) == 4
+    # every reply's trace resolves to ITS root, ids and protos intact
+    assert roots[bin_reps[0]["trace"]]["id"] == 0
+    assert roots[bin_reps[1]["trace"]]["id"] == 1
+    assert roots[bin_reps[0]["trace"]]["proto"] == "binary"
+    assert roots[js1["trace"]]["id"] == 2
+    assert roots[js1["trace"]]["proto"] == "json"
+    assert roots[js1["trace"]]["op"] == "add"
+    assert roots[js2["trace"]]["id"] == "rid-x"
+    # the window-level join spans carry every sampled member
+    members = sorted(roots)
+    for name in ("gw.admit_batch", "gw.ingest_window"):
+        join = [s for s in spans if s["name"] == name]
+        assert len(join) == 1, name
+        assert sorted(join[0]["member_traces"]) == members
+
+
+# ------------------------------------------------------------- observability
+def test_ingest_histograms_step_stamped():
+    from akka_tpu.event.metrics import MetricsRegistry
+    reg = MetricsRegistry()
+    reg.set_step(42)
+    srv = _server(OkBackend(), registry=reg)
+    agg = IngestAggregator(srv, max_window=4, window_s=50e-3,
+                           registry=reg)
+    try:
+        barrier = threading.Barrier(4)
+        outs = [None] * 4
+
+        def client(i):
+            barrier.wait()
+            outs[i] = agg.submit(
+                _json_body(i, "t0", f"hg-{i}", "get"), conn_id=i)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for fut in outs:
+            fut.result(10.0)
+        size = reg.histogram("gateway_ingest_window_size").snapshot()
+        wait = reg.histogram("gateway_ingest_window_wait_us").snapshot()
+        assert size["count"] >= 1 and size["sum"] == 4.0
+        assert size["step"] == 42
+        assert wait["count"] == 4 and wait["step"] == 42
+        # the registry carries the ingest_window summary as a collector
+        collected = reg.snapshot()["collected"]
+        assert collected["ingest_window_records"] == 4.0
+    finally:
+        agg.close()
+
+
+# -------------------------------------------------------- TCP FIFO pipeline
+def test_per_connection_fifo_under_depth_k_pipelining():
+    """Depth-k pipelined clients against an aggregating server over real
+    TCP, with murmur3-jittered backend latency so window boundaries land
+    unpredictably mid-stream: every connection's replies still come back
+    in submit order (the client raises on any out-of-order first id) and
+    every value is correct."""
+    from akka_tpu import ActorSystem
+    from akka_tpu.testkit.chaos import chaos_uniform_np
+
+    class JitterBackend:
+        def __init__(self, seed=31):
+            self.seed = seed
+            self._n = 0
+            self._lock = threading.Lock()
+
+        def ask(self, entity_id, value):
+            with self._lock:
+                self._n += 1
+                n = self._n
+            time.sleep(float(chaos_uniform_np(self.seed, n, 0)) * 2e-3)
+            return float(value)
+
+    system = ActorSystem("gw-ingest-fifo",
+                         {"akka": {"stdout-loglevel": "OFF",
+                                   "log-dead-letters": 0}})
+    try:
+        srv = GatewayServer(system, JitterBackend(),
+                            AdmissionController(rate=1e9, burst=1e9),
+                            SloTracker(), aggregate=True, max_window=8,
+                            window_wait_s=300e-6, pipeline_depth=4)
+        host, port = srv.start()
+        n_conns, n_windows = 3, 10
+        errs = []
+
+        def client(c):
+            cl = GatewayClient(host, port)
+            try:
+                windows = [[("t0", f"fifo-{c}", "add", float(c * 100 + w)),
+                            ("t0", f"fifo-{c}-b", "get", 0.0)]
+                           for w in range(n_windows)]
+                # request_many_pipelined raises if replies reorder
+                replies = cl.request_many_pipelined(windows, depth=4)
+                for w, reps in enumerate(replies):
+                    assert reps[0]["status"] == "ok"
+                    assert reps[0]["value"] == float(c * 100 + w)
+            except Exception as e:  # noqa: BLE001 — surface in main
+                errs.append((c, e))
+            finally:
+                cl.close()
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(n_conns)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        st = srv.aggregator.stats()
+        assert st["records"] == n_conns * n_windows * 2
+        assert st["pending"] == 0.0
+        srv.stop()
+    finally:
+        system.terminate()

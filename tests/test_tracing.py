@@ -165,8 +165,9 @@ def test_from_config_gating_and_jsonl_sink(tmp_path):
 def test_thread_hop_parent_child_integrity(region):
     """JSON requests from concurrent client threads ride the AskBatcher's
     dispatcher thread; every ask.member span must still be parented under
-    ITS submitter's gw.ask span (the ctx snapshot taken by submit), and
-    no span may reference a parent that was never emitted."""
+    ITS submitter's gw.request root (the ctx that rides next to the ask —
+    solo JSON serves through the same columnar window path as binary),
+    and no span may reference a parent that was never emitted."""
     tr = Tracer(sample_rate=1.0, seed=21)
     srv, backend = _server(region, tr)
     try:
@@ -193,7 +194,7 @@ def test_thread_hop_parent_child_integrity(region):
     members = [s for s in spans if s["name"] == "ask.member"]
     assert len(members) == 12  # one per request, across the thread hop
     for m in members:
-        assert by_id[(m["trace"], m["parent"])]["name"] == "gw.ask"
+        assert by_id[(m["trace"], m["parent"])]["name"] == "gw.request"
         assert m["outcome"] == "reply" and m["step1"] >= m["step0"]
     # each trace is one complete request tree rooted at gw.request
     roots = [s for s in spans if s["name"] == "gw.request"]

@@ -442,6 +442,30 @@ def on_tpu_found(detail: str) -> None:
                                 ab.get("json", {}).get("req_per_sec"),
                             "binary_p99_ms":
                                 ab.get("binary", {}).get("p99_ms")})
+            ia = gw.get("ingest_ab", {})
+            if ia:
+                # cross-connection ingest windowing (ISSUE 13): solo
+                # frames from 64 concurrent clients, aggregator on vs
+                # off at equal admission; acceptance is aggregated JSON
+                # >= 2x per-frame req/s with real coalescing
+                # (mean window size > 1)
+                jl = ia.get("json", {})
+                append_log({"ts": _utcnow(),
+                            "ok": bool(ia.get("ok")) and
+                                  bool(jl.get("equal_admission")),
+                            "detail": "cross-connection ingest windowing "
+                                      "(64 clients, equal admission)",
+                            "ingest_speedup": ia.get("speedup"),
+                            "mean_window_size":
+                                ia.get("mean_window_size"),
+                            "aggregated_req_per_sec":
+                                jl.get("aggregated", {})
+                                .get("req_per_sec"),
+                            "per_frame_req_per_sec":
+                                jl.get("per_frame", {})
+                                .get("req_per_sec"),
+                            "mixed_speedup":
+                                ia.get("mixed", {}).get("speedup")})
     # wire-decode throughput: batch np.frombuffer vs json.loads, plus the
     # full-path 1/8/64-client encoding sweep (docs/SERVING_GATEWAY.md
     # wire-protocol section)
