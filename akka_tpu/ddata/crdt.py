@@ -612,16 +612,186 @@ class LWWRegister(ReplicatedData, Generic[A]):
 # -- maps -------------------------------------------------------------------
 
 
+class ORMapDeltaOp:
+    """Op-based ORMap delta algebra (reference: ORMap.scala:30-110
+    PutDeltaOp/UpdateDeltaOp/RemoveDeltaOp/RemoveKeyDeltaOp/DeltaGroup):
+    a 1-entry change ships one key op + one entry, not the whole map.
+
+    Every op carries a `zero_tag` — the TOP-LEVEL map class (ORMap or a
+    derived wrapper) — so a replica that has never seen the key can
+    reconstruct the right type from nothing: `op.zero().merge_delta(op)`
+    (reference: ZeroTag.scala, the replicator's first-sight path)."""
+
+    __slots__ = ()
+
+    def zero(self) -> "ReplicatedData":
+        return self.zero_tag.empty()  # type: ignore[attr-defined]
+
+    def merge(self, that: "ORMapDeltaOp") -> "ORMapDeltaOp":
+        if isinstance(that, ORMapDeltaGroup):
+            return ORMapDeltaGroup((self,) + that.ops)
+        return ORMapDeltaGroup((self, that))
+
+
+class ORMapPutDeltaOp(ORMapDeltaOp):
+    """Destructive entry write: ships the key's ORSet add op + the FULL
+    value (put replaces; only `updated` ships value deltas)."""
+
+    __slots__ = ("key_op", "key", "value", "zero_tag")
+
+    def __init__(self, key_op: ORSetDeltaOp, key, value: ReplicatedData,
+                 zero_tag: type):
+        self.key_op = key_op
+        self.key = key
+        self.value = value
+        self.zero_tag = zero_tag
+
+    def merge(self, that: ORMapDeltaOp) -> ORMapDeltaOp:
+        if isinstance(that, ORMapPutDeltaOp) and that.key == self.key:
+            # a later put of the SAME key supersedes within the tick
+            return ORMapPutDeltaOp(self.key_op.merge(that.key_op),
+                                   self.key, that.value, self.zero_tag)
+        return super().merge(that)
+
+    def __eq__(self, other):
+        return (isinstance(other, ORMapPutDeltaOp)
+                and self.key_op == other.key_op and self.key == other.key
+                and self.value == other.value
+                and self.zero_tag is other.zero_tag)
+
+    def __hash__(self):
+        return hash(("put", self.key_op, self.key))
+
+
+class ORMapUpdateDeltaOp(ORMapDeltaOp):
+    """In-place entry update: ships the key's ORSet add op + the value's
+    own DELTA per key (a counter increment rides as {node: count}, an
+    ORSet binding as one AddDeltaOp — O(entry), never O(map)). Falls back
+    to the full value for non-delta value types; `merge_delta` tells the
+    two apart by type. Consecutive updates between propagation ticks
+    coalesce: key ops merge, per-key value deltas merge."""
+
+    __slots__ = ("key_op", "values", "zero_tag")
+
+    def __init__(self, key_op: ORSetDeltaOp, values: Dict[Any, Any],
+                 zero_tag: type):
+        self.key_op = key_op
+        self.values = dict(values)
+        self.zero_tag = zero_tag
+
+    def merge(self, that: ORMapDeltaOp) -> ORMapDeltaOp:
+        if isinstance(that, ORMapUpdateDeltaOp) \
+                and that.zero_tag is self.zero_tag:
+            vals = dict(self.values)
+            for k, d in that.values.items():
+                cur = vals.get(k)
+                vals[k] = d if cur is None else cur.merge(d)
+            return ORMapUpdateDeltaOp(self.key_op.merge(that.key_op),
+                                      vals, self.zero_tag)
+        return super().merge(that)
+
+    def __eq__(self, other):
+        return (isinstance(other, ORMapUpdateDeltaOp)
+                and self.key_op == other.key_op
+                and self.values == other.values
+                and self.zero_tag is other.zero_tag)
+
+    def __hash__(self):
+        return hash(("update", self.key_op, frozenset(self.values)))
+
+
+class ORMapRemoveDeltaOp(ORMapDeltaOp):
+    """Key removal dropping the value: ships the key's ORSet remove op
+    (one element + the remover's causal context). The entry disappears
+    with the key; value types that need their causal context preserved
+    across a remove (ORMultiMap) use RemoveKeyDeltaOp instead."""
+
+    __slots__ = ("key_op", "key", "zero_tag")
+
+    def __init__(self, key_op: ORSetDeltaOp, key, zero_tag: type):
+        self.key_op = key_op
+        self.key = key
+        self.zero_tag = zero_tag
+
+    def __eq__(self, other):
+        return (isinstance(other, ORMapRemoveDeltaOp)
+                and self.key_op == other.key_op and self.key == other.key
+                and self.zero_tag is other.zero_tag)
+
+    def __hash__(self):
+        return hash(("remove", self.key_op, self.key))
+
+
+class ORMapRemoveKeyDeltaOp(ORMapDeltaOp):
+    """Key removal RETAINING the value as a tombstone (reference:
+    ORMap.scala RemoveKeyDeltaOp): the cleared value keeps its causal
+    context so a concurrent binding update converges instead of
+    resurrecting removed elements — the ORMultiMap remove path clears
+    the set (a value delta) then removes the key with this op."""
+
+    __slots__ = ("key_op", "key", "zero_tag")
+
+    def __init__(self, key_op: ORSetDeltaOp, key, zero_tag: type):
+        self.key_op = key_op
+        self.key = key
+        self.zero_tag = zero_tag
+
+    def __eq__(self, other):
+        return (isinstance(other, ORMapRemoveKeyDeltaOp)
+                and self.key_op == other.key_op and self.key == other.key
+                and self.zero_tag is other.zero_tag)
+
+    def __hash__(self):
+        return hash(("remove_key", self.key_op, self.key))
+
+
+class ORMapDeltaGroup(ORMapDeltaOp):
+    """Ordered batch of atomic ops between propagation ticks; an incoming
+    op first tries to coalesce with the trailing op."""
+
+    __slots__ = ("ops",)
+
+    def __init__(self, ops):
+        self.ops = tuple(ops)
+
+    @property
+    def zero_tag(self) -> type:
+        return self.ops[0].zero_tag  # type: ignore[attr-defined]
+
+    def merge(self, that: ORMapDeltaOp) -> ORMapDeltaOp:
+        if isinstance(that, ORMapDeltaGroup):
+            return ORMapDeltaGroup(self.ops + that.ops)
+        if self.ops:
+            tail = self.ops[-1].merge(that)
+            if not isinstance(tail, ORMapDeltaGroup):
+                return ORMapDeltaGroup(self.ops[:-1] + (tail,))
+        return ORMapDeltaGroup(self.ops + (that,))
+
+    def __eq__(self, other):
+        return isinstance(other, ORMapDeltaGroup) and self.ops == other.ops
+
+    def __hash__(self):
+        return hash(self.ops)
+
+
 class ORMap(DeltaReplicatedData, RemovedNodePruning, Generic[A]):
     """Observed-remove map: ORSet of keys + per-key ReplicatedData values
     merged recursively (reference: ORMap.scala).
 
-    Deliberate deviation: ORMap deltas are FULL-STATE snapshots (correct —
-    merge is idempotent — but not bandwidth-minimal), while ORSet ships
-    op-based deltas. The reference's ORMap Put/Update/Remove delta algebra
-    (ORMap.scala:30-110, zero-tag value reconstruction) is an optimisation
-    layered on the same causal-delivery discipline the replicator now
-    enforces; the seam to add it later is merge_delta below."""
+    Deltas are OP-BASED (previously full-state snapshots): put/updated/
+    remove emit Put/Update/Remove/RemoveKey ops carrying one key op and
+    one entry (or just the entry's own delta), the DeltaGroup algebra of
+    ORMap.scala:30-110 with zero-tag reconstruction for replicas that
+    have never seen the key and the causal guard on update application
+    (a value delta only applies if its key survived the key-set merge).
+
+    Known reference anomaly, kept for parity: a remove() concurrent with
+    an updated() of the SAME key can transiently differ between the op
+    path (the update's value delta resurrects the entry from zero) and
+    the full-merge path; full-state gossip reconciles. Value types whose
+    causal context must survive a remove use remove_key() tombstones —
+    ORMultiMap does (clear-then-remove_key, merge retaining deleted
+    values); PNCounterMap/LWWMap accept the documented anomaly."""
 
     __slots__ = ("keys", "entries", "_delta")
 
@@ -645,31 +815,82 @@ class ORMap(DeltaReplicatedData, RemovedNodePruning, Generic[A]):
     def __contains__(self, key) -> bool:
         return key in self.entries
 
-    def put(self, node: str, key, value: ReplicatedData) -> "ORMap":
-        new_keys = self.keys.add(node, key)
+    def _push_delta(self, op: ORMapDeltaOp) -> ORMapDeltaOp:
+        return op if self._delta is None else self._delta.merge(op)
+
+    def _key_add_op(self, node: str, key) -> Tuple[ORSet, ORSetDeltaOp]:
+        """One key-set add as (new reset keys, the ORSet op it emitted)."""
+        nk = self.keys.reset_delta().add(node, key)
+        return nk.reset_delta(), nk.delta  # type: ignore[return-value]
+
+    def put(self, node: str, key, value: ReplicatedData,
+            _tag: Optional[type] = None) -> "ORMap":
+        new_keys, key_op = self._key_add_op(node, key)
         entries = dict(self.entries)
         entries[key] = value
-        out = ORMap(new_keys, entries)
-        out._delta = out  # full-state delta snapshot (ORSet-style)
-        return out
+        op = ORMapPutDeltaOp(key_op, key, value, _tag or ORMap)
+        return ORMap(new_keys, entries, _delta=self._push_delta(op))
 
     def updated(self, node: str, key, initial: ReplicatedData,
-                modify: Callable[[ReplicatedData], ReplicatedData]) -> "ORMap":
+                modify: Callable[[ReplicatedData], ReplicatedData],
+                _tag: Optional[type] = None) -> "ORMap":
+        tag = _tag or ORMap
+        new_keys, key_op = self._key_add_op(node, key)
         cur = self.entries.get(key, initial)
-        return self.put(node, key, modify(cur))
+        entries = dict(self.entries)
+        if isinstance(cur, DeltaReplicatedData):
+            # ship the value's OWN delta (reference: valueDeltas branch of
+            # ORMap.updated) — a counter increment gossips {node: count}
+            new_val = modify(cur.reset_delta())
+            vd = new_val.delta \
+                if isinstance(new_val, DeltaReplicatedData) else None
+            if vd is not None:
+                op: ORMapDeltaOp = ORMapUpdateDeltaOp(key_op, {key: vd}, tag)
+                entries[key] = new_val.reset_delta()
+            else:  # modify produced no delta: ship the full value
+                op = ORMapPutDeltaOp(key_op, key, new_val, tag)
+                entries[key] = new_val
+        else:
+            new_val = modify(cur)
+            op = ORMapUpdateDeltaOp(key_op, {key: new_val}, tag)
+            entries[key] = new_val
+        return ORMap(new_keys, entries, _delta=self._push_delta(op))
 
-    def remove(self, node: str, key) -> "ORMap":
-        new_keys = self.keys.remove(node, key)
+    def remove(self, node: str, key, _tag: Optional[type] = None) -> "ORMap":
+        nk = self.keys.reset_delta().remove(node, key)
         entries = dict(self.entries)
         entries.pop(key, None)
-        out = ORMap(new_keys, entries)
-        out._delta = out
-        return out
+        op = ORMapRemoveDeltaOp(nk.delta, key,  # type: ignore[arg-type]
+                                _tag or ORMap)
+        return ORMap(nk.reset_delta(), entries, _delta=self._push_delta(op))
+
+    def remove_key(self, node: str, key,
+                   _tag: Optional[type] = None) -> "ORMap":
+        """Remove the key but KEEP its value as a tombstone (reference:
+        ORMap.removeKey) — the ORMultiMap clear-then-remove path, so the
+        value's causal context survives for concurrent binding updates."""
+        nk = self.keys.reset_delta().remove(node, key)
+        op = ORMapRemoveKeyDeltaOp(nk.delta, key,  # type: ignore[arg-type]
+                                   _tag or ORMap)
+        return ORMap(nk.reset_delta(), self.entries,
+                     _delta=self._push_delta(op))
 
     def merge(self, other: "ORMap") -> "ORMap":
+        return self._merge(other, retain_deleted=False)
+
+    def merge_retaining_deleted_values(self, other: "ORMap") -> "ORMap":
+        """(reference: ORMap.mergeRetainingDeletedValues) — tombstone
+        entries whose keys left the key set survive the merge; the
+        ORMultiMap merge path."""
+        return self._merge(other, retain_deleted=True)
+
+    def _merge(self, other: "ORMap", retain_deleted: bool) -> "ORMap":
         merged_keys = self.keys.merge(other.keys)
+        keep = set(merged_keys.element_map)
+        if retain_deleted:
+            keep |= set(self.entries) | set(other.entries)
         entries: Dict[Any, ReplicatedData] = {}
-        for key in merged_keys.elements:
+        for key in keep:
             mine, theirs = self.entries.get(key), other.entries.get(key)
             if mine is not None and theirs is not None:
                 entries[key] = mine.merge(theirs)
@@ -680,14 +901,94 @@ class ORMap(DeltaReplicatedData, RemovedNodePruning, Generic[A]):
         return ORMap(merged_keys, entries, self._delta)
 
     @property
-    def delta(self) -> Optional["ORMap"]:
+    def delta(self) -> Optional[ORMapDeltaOp]:
         return self._delta
 
     def reset_delta(self) -> "ORMap":
         return ORMap(self.keys.reset_delta(), self.entries)
 
-    def merge_delta(self, delta: "ORMap") -> "ORMap":
+    def merge_delta(self, delta) -> "ORMap":
+        """Apply an op-based delta (reference: ORMap.mergeDelta /
+        dryMergeDelta); a plain ORMap (legacy full-state delta) still
+        full-merges."""
+        if isinstance(delta, ORMapDeltaOp):
+            return self._dry_merge_delta(delta, retain_deleted=False)
         return self.merge(delta)
+
+    def merge_delta_retaining_deleted_values(self, delta) -> "ORMap":
+        if isinstance(delta, ORMapDeltaOp):
+            return self._dry_merge_delta(delta, retain_deleted=True)
+        return self.merge_retaining_deleted_values(delta)
+
+    def _dry_merge_delta(self, delta: ORMapDeltaOp,
+                         retain_deleted: bool) -> "ORMap":
+        """The op fold (reference: ORMap.dryMergeDelta): ops build a
+        side-map of values which then FULL-MERGES with the local entries
+        per key — so concurrent puts converge commutatively (register
+        merge picks the winner) instead of diverging by application
+        order. Update values apply under the causal guard: a value delta
+        lands only if its key survived the key-set merge (an add our
+        vvector already observed-and-removed stays removed)."""
+        ops = delta.ops if isinstance(delta, ORMapDeltaGroup) else (delta,)
+        merged_keys = self.keys
+        merged_values: Dict[Any, Any] = {}
+        tombstoned: Dict[Any, ReplicatedData] = {}
+        for op in ops:
+            if isinstance(op, ORMapDeltaGroup):
+                raise ValueError("ORMap DeltaGroup must not be nested")
+            if isinstance(op, ORMapPutDeltaOp):
+                merged_keys = merged_keys.merge_delta(op.key_op)
+                merged_values[op.key] = op.value
+            elif isinstance(op, ORMapRemoveDeltaOp):
+                merged_values.pop(op.key, None)
+                merged_keys = merged_keys.merge_delta(op.key_op)
+            elif isinstance(op, ORMapRemoveKeyDeltaOp):
+                if op.key in self.entries:
+                    tombstoned[op.key] = self.entries[op.key]
+                merged_keys = merged_keys.merge_delta(op.key_op)
+            elif isinstance(op, ORMapUpdateDeltaOp):
+                merged_keys = merged_keys.merge_delta(op.key_op)
+                for k, vd in op.values.items():
+                    if k not in merged_keys.element_map:
+                        # causal guard: the key's add was already observed
+                        # AND removed here — the stale value delta must
+                        # not resurrect it
+                        continue
+                    cur = merged_values.get(k)
+                    if cur is None:
+                        # seed from the local entry (reference parity): the
+                        # value delta applies ONTO what this replica holds,
+                        # not onto a zero-reconstruction whose vvector would
+                        # dominate-and-drop the local elements on merge
+                        cur = tombstoned.get(k, self.entries.get(k))
+                    if cur is not None:
+                        merged_values[k] = (
+                            cur.merge_delta(vd)
+                            if isinstance(cur, DeltaReplicatedData)
+                            else cur.merge(vd))
+                    else:
+                        # zero-tag value reconstruction: an op-style value
+                        # delta (ORSetDeltaOp) rebuilds against its zero;
+                        # counter deltas ARE valid state (absolute counts)
+                        z = getattr(vd, "zero", None)
+                        merged_values[k] = \
+                            z().merge_delta(vd) if z is not None else vd
+            else:
+                raise ValueError(f"unknown ORMap delta op {op!r}")
+        keep = set(merged_keys.element_map)
+        if retain_deleted:
+            keep |= set(self.entries) | set(tombstoned) | set(merged_values)
+        entries: Dict[Any, ReplicatedData] = {}
+        for key in keep:
+            mine = self.entries.get(key)
+            theirs = merged_values.get(key)
+            if mine is not None and theirs is not None:
+                entries[key] = mine.merge(theirs)
+            elif mine is not None:
+                entries[key] = mine
+            elif theirs is not None:
+                entries[key] = theirs
+        return ORMap(merged_keys, entries, self._delta)
 
     def modified_by_nodes(self) -> FrozenSet[str]:
         out = set(self.keys.modified_by_nodes())
@@ -721,8 +1022,16 @@ class ORMap(DeltaReplicatedData, RemovedNodePruning, Generic[A]):
         return f"ORMap({dict(self.entries)!r})"
 
 
-class ORMultiMap(ReplicatedData, Generic[A]):
-    """key -> ORSet of values (reference: ORMultiMap.scala)."""
+class ORMultiMap(DeltaReplicatedData, Generic[A]):
+    """key -> ORSet of values (reference: ORMultiMap.scala, the
+    withValueDeltas variant): binding changes ship as the value set's OWN
+    op deltas inside ORMap UpdateDeltaOps, and key removal is
+    clear-then-remove_key so the emptied set survives as a tombstone
+    carrying its causal context — a concurrent add_binding then converges
+    (removed elements stay removed, the new binding lands) instead of
+    resurrecting the whole set. Tombstones are invisible through
+    get/entries/contains (filtered to live keys) and survive merges via
+    merge_retaining_deleted_values."""
 
     __slots__ = ("underlying",)
 
@@ -733,30 +1042,38 @@ class ORMultiMap(ReplicatedData, Generic[A]):
     def empty() -> "ORMultiMap":
         return ORMultiMap()
 
+    def _live(self, key) -> bool:
+        return key in self.underlying.keys.element_map
+
     def get(self, key) -> FrozenSet:
+        if not self._live(key):
+            return frozenset()
         s = self.underlying.get(key)
         return s.elements if isinstance(s, ORSet) else frozenset()
 
     def contains(self, key) -> bool:
-        return key in self.underlying
+        return self._live(key) and key in self.underlying
 
     @property
     def entries(self) -> Dict[Any, FrozenSet]:
         return {k: v.elements for k, v in self.underlying.entries.items()
-                if isinstance(v, ORSet)}
+                if isinstance(v, ORSet) and self._live(k)}
 
     def add_binding(self, node: str, key, value) -> "ORMultiMap":
         return ORMultiMap(self.underlying.updated(
-            node, key, ORSet(), lambda s: s.add(node, value)))
+            node, key, ORSet(), lambda s: s.add(node, value),
+            _tag=ORMultiMap))
 
     def remove_binding(self, node: str, key, value) -> "ORMultiMap":
-        cur = self.underlying.get(key)
-        if not isinstance(cur, ORSet) or value not in cur:
+        if value not in self.get(key):
             return self
-        new_set = cur.remove(node, value)
-        if not new_set.element_map:
-            return ORMultiMap(self.underlying.remove(node, key))
-        return ORMultiMap(self.underlying.put(node, key, new_set))
+        u = self.underlying.updated(
+            node, key, ORSet(), lambda s: s.remove(node, value),
+            _tag=ORMultiMap)
+        got = u.get(key)
+        if isinstance(got, ORSet) and not got.element_map:
+            u = u.remove_key(node, key, _tag=ORMultiMap)
+        return ORMultiMap(u)
 
     def replace_binding(self, node: str, key, old, new) -> "ORMultiMap":
         if old == new:  # guard: add-then-remove of the same element would
@@ -764,16 +1081,37 @@ class ORMultiMap(ReplicatedData, Generic[A]):
         return self.add_binding(node, key, new).remove_binding(node, key, old)
 
     def put(self, node: str, key, values) -> "ORMultiMap":
-        s = ORSet()
-        for v in values:
-            s = s.add(node, v)
-        return ORMultiMap(self.underlying.put(node, key, s))
+        vals = list(values)
+
+        def replace(s: ORSet) -> ORSet:
+            out = s.clear()  # clear observes the old dots (value delta)
+            for v in vals:
+                out = out.add(node, v)
+            return out
+        return ORMultiMap(self.underlying.updated(
+            node, key, ORSet(), replace, _tag=ORMultiMap))
 
     def remove(self, node: str, key) -> "ORMultiMap":
-        return ORMultiMap(self.underlying.remove(node, key))
+        u = self.underlying.updated(
+            node, key, ORSet(), lambda s: s.clear(), _tag=ORMultiMap)
+        return ORMultiMap(u.remove_key(node, key, _tag=ORMultiMap))
 
     def merge(self, other: "ORMultiMap") -> "ORMultiMap":
-        return ORMultiMap(self.underlying.merge(other.underlying))
+        return ORMultiMap(self.underlying.merge_retaining_deleted_values(
+            other.underlying))
+
+    @property
+    def delta(self) -> Optional[ORMapDeltaOp]:
+        return self.underlying.delta
+
+    def reset_delta(self) -> "ORMultiMap":
+        return ORMultiMap(self.underlying.reset_delta())
+
+    def merge_delta(self, delta) -> "ORMultiMap":
+        if isinstance(delta, ORMultiMap):
+            return self.merge(delta)
+        return ORMultiMap(
+            self.underlying.merge_delta_retaining_deleted_values(delta))
 
     def __eq__(self, other):
         return isinstance(other, ORMultiMap) and self.underlying == other.underlying
@@ -785,8 +1123,11 @@ class ORMultiMap(ReplicatedData, Generic[A]):
         return f"ORMultiMap({self.entries!r})"
 
 
-class PNCounterMap(ReplicatedData):
-    """key -> PNCounter (reference: PNCounterMap.scala)."""
+class PNCounterMap(DeltaReplicatedData):
+    """key -> PNCounter (reference: PNCounterMap.scala). Increments ship
+    as the counter's own delta ({node: absolute count}) inside an ORMap
+    UpdateDeltaOp — O(entry) gossip; the reference's documented
+    remove-vs-concurrent-update anomaly applies (see ORMap docstring)."""
 
     __slots__ = ("underlying",)
 
@@ -808,17 +1149,32 @@ class PNCounterMap(ReplicatedData):
 
     def increment(self, node: str, key, n: int = 1) -> "PNCounterMap":
         return PNCounterMap(self.underlying.updated(
-            node, key, PNCounter(), lambda c: c.increment(node, n)))
+            node, key, PNCounter(), lambda c: c.increment(node, n),
+            _tag=PNCounterMap))
 
     def decrement(self, node: str, key, n: int = 1) -> "PNCounterMap":
         return PNCounterMap(self.underlying.updated(
-            node, key, PNCounter(), lambda c: c.decrement(node, n)))
+            node, key, PNCounter(), lambda c: c.decrement(node, n),
+            _tag=PNCounterMap))
 
     def remove(self, node: str, key) -> "PNCounterMap":
-        return PNCounterMap(self.underlying.remove(node, key))
+        return PNCounterMap(self.underlying.remove(node, key,
+                                                   _tag=PNCounterMap))
 
     def merge(self, other: "PNCounterMap") -> "PNCounterMap":
         return PNCounterMap(self.underlying.merge(other.underlying))
+
+    @property
+    def delta(self) -> Optional[ORMapDeltaOp]:
+        return self.underlying.delta
+
+    def reset_delta(self) -> "PNCounterMap":
+        return PNCounterMap(self.underlying.reset_delta())
+
+    def merge_delta(self, delta) -> "PNCounterMap":
+        if isinstance(delta, PNCounterMap):
+            return self.merge(delta)
+        return PNCounterMap(self.underlying.merge_delta(delta))
 
     def __eq__(self, other):
         return isinstance(other, PNCounterMap) and self.underlying == other.underlying
@@ -830,8 +1186,10 @@ class PNCounterMap(ReplicatedData):
         return f"PNCounterMap({self.entries!r})"
 
 
-class LWWMap(ReplicatedData, Generic[A]):
-    """key -> LWWRegister (reference: LWWMap.scala)."""
+class LWWMap(DeltaReplicatedData, Generic[A]):
+    """key -> LWWRegister (reference: LWWMap.scala). A put ships one
+    PutDeltaOp carrying one register; the dry-merge's final full-merge
+    per key keeps concurrent puts commutative (timestamp winner)."""
 
     __slots__ = ("underlying",)
 
@@ -859,13 +1217,25 @@ class LWWMap(ReplicatedData, Generic[A]):
         cur = self.underlying.get(key)
         reg = (cur.with_value(node, value, clock) if isinstance(cur, LWWRegister)
                else LWWRegister.create(node, value, clock))
-        return LWWMap(self.underlying.put(node, key, reg))
+        return LWWMap(self.underlying.put(node, key, reg, _tag=LWWMap))
 
     def remove(self, node: str, key) -> "LWWMap":
-        return LWWMap(self.underlying.remove(node, key))
+        return LWWMap(self.underlying.remove(node, key, _tag=LWWMap))
 
     def merge(self, other: "LWWMap") -> "LWWMap":
         return LWWMap(self.underlying.merge(other.underlying))
+
+    @property
+    def delta(self) -> Optional[ORMapDeltaOp]:
+        return self.underlying.delta
+
+    def reset_delta(self) -> "LWWMap":
+        return LWWMap(self.underlying.reset_delta())
+
+    def merge_delta(self, delta) -> "LWWMap":
+        if isinstance(delta, LWWMap):
+            return self.merge(delta)
+        return LWWMap(self.underlying.merge_delta(delta))
 
     def __eq__(self, other):
         return isinstance(other, LWWMap) and self.underlying == other.underlying

@@ -424,6 +424,20 @@ class Replicator(Actor):
         # pruned — application-chosen logical CRDT node ids never are)
         self.removed_nodes: Set[str] = set()
         self._digest_cache: Dict[str, bytes] = {}
+        # gossip-size observability: payload bytes per propagation tick and
+        # the op-delta-vs-full-state ratio, step-stamped on the shared
+        # ATT_STEP axis so the O(entry) claim is visible in the metrics
+        # plane (docs/OBSERVABILITY.md)
+        reg = getattr(self.context.system, "metrics_registry", None)
+        self._metrics = reg
+        self._h_gossip_bytes = reg.histogram(
+            "ddata_gossip_payload_bytes",
+            "bytes per outbound replication payload (delta tick or "
+            "full-state gossip)") if reg is not None else None
+        self._h_delta_vs_full = reg.histogram(
+            "ddata_delta_vs_full",
+            "per-key op-delta size as a fraction of the full-state size, "
+            "observed per delta-propagation tick") if reg is not None else None
         self._cluster_listener = lambda e: self.self_ref.tell(e)
         self._tasks: List[Any] = []
         self.durable = None
@@ -875,6 +889,7 @@ class Replicator(Actor):
         # keys the peer has that we lack -> ask for exactly those back
         missing = tuple(k for k in msg.digests if k not in self.data)
         if to_send or missing:
+            self._observe_gossip_bytes(to_send)
             self._replicator_at(msg.from_addr).tell(
                 _Gossip(to_send, want_keys=missing, from_addr=self.self_addr,
                         tombstones=self._tombstones_wire(),
@@ -913,12 +928,23 @@ class Replicator(Actor):
         if msg.want_keys:
             back = {k: self.data[k] for k in msg.want_keys if k in self.data}
             if back:
+                self._observe_gossip_bytes(back)
                 self._replicator_at(msg.from_addr).tell(
                     _Gossip(back, want_keys=(), from_addr=self.self_addr,
                             tombstones=self._tombstones_wire(),
                             delta_seq=self._delta_seq_for(back),
                             origin_uid=self._delta_incarnation),
                     self.self_ref)
+
+    def _observe_gossip_bytes(self, entries: Dict[str, Any]) -> None:
+        if self._h_gossip_bytes is None or not entries:
+            return
+        from ..serialization.codec import WireCodecError, dumps
+        try:
+            self._h_gossip_bytes.observe(float(len(dumps(entries))),
+                                         step=self._metrics.step)
+        except WireCodecError:
+            pass
 
     def _delta_seq_for(self, entries: Dict[str, Any]) -> Dict[str, int]:
         return {k: self.delta_seq[k] for k in entries if k in self.delta_seq}
@@ -947,11 +973,32 @@ class Replicator(Actor):
             for k, d in self.deltas.items():
                 self.delta_seq[k] = self.delta_seq.get(k, 0) + 1
                 payload[k] = (self.delta_seq[k], d)
+            self._observe_delta_sizes(payload)
             for addr in nodes:
                 self._replicator_at(addr).tell(
                     _DeltaPropagation(payload, self.self_addr,
                                       self._delta_incarnation), self.self_ref)
         self.deltas.clear()
+
+    def _observe_delta_sizes(self, payload: Dict[str, Any]) -> None:
+        """Per propagation tick: outbound payload bytes + each key's
+        op-delta-size : full-state-size ratio (the O(entry) evidence)."""
+        if self._h_gossip_bytes is None:
+            return
+        from ..serialization.codec import WireCodecError, dumps
+        step = self._metrics.step
+        try:
+            self._h_gossip_bytes.observe(float(len(dumps(payload))), step=step)
+            for k, (_seq, d) in payload.items():
+                full = self.data.get(k)
+                if full is None or full == DELETED:
+                    continue
+                full_n = len(dumps(full))
+                if full_n:
+                    self._h_delta_vs_full.observe(
+                        len(dumps(d)) / full_n, step=step)
+        except WireCodecError:
+            pass  # unsized payloads must never break propagation
 
     # -- pruning (simplified leader-driven collapse) -------------------------
     def _prune_tick(self) -> None:

@@ -302,11 +302,18 @@ class GatewayServer:
                  max_frame: int = DEFAULT_MAX_FRAME, registry=None,
                  tracer=None, aggregate: bool = False,
                  max_window: int = 64, window_wait_s: float = 150e-6,
-                 pipeline_depth: int = 4):
+                 pipeline_depth: int = 4, replica_cache=None):
         self.system = system
         self.backend = backend
         self.admission = admission
         self.slo = slo
+        # replicated hot-key read path (ISSUE 14): optional
+        # ReadReplicaCache — gets for hot entities answered before the
+        # ask wave under its bounded-staleness contract, every wave's ok
+        # totals published back at the flush boundary
+        self.replica_cache = replica_cache
+        if replica_cache is not None and slo is not None:
+            slo.attach_replica_cache(replica_cache)
         self.host = host
         self.port = port
         self.max_frame = max_frame
@@ -545,7 +552,7 @@ class GatewayServer:
                                              json_reqs, n)
 
         t_serve0 = time.monotonic() if tr is not None else 0.0
-        ids, status, reason, value, retry, traces = \
+        ids, status, reason, value, retry, traces, step_lag = \
             self._serve_records(rec, decode_t, aux)
 
         if tr is not None and traces is not None and len(windowed) > 1:
@@ -562,10 +569,12 @@ class GatewayServer:
                 out[f] = frames.encode_reply_batch(
                     ids[lo:hi], status[lo:hi], reason[lo:hi],
                     value[lo:hi], retry[lo:hi],
-                    None if traces is None else traces[lo:hi])
+                    None if traces is None else traces[lo:hi],
+                    step_lag[lo:hi])
             else:
                 out[f] = encode_body(self._row_reply(
-                    lo, ids, status, reason, value, retry, traces, aux))
+                    lo, ids, status, reason, value, retry, traces, aux,
+                    step_lag))
         return out  # type: ignore[return-value]
 
     @staticmethod
@@ -622,10 +631,13 @@ class GatewayServer:
 
     @staticmethod
     def _row_reply(r: int, ids, status, reason, value, retry, traces,
-                   aux: Optional[_WindowAux]) -> Dict[str, Any]:
+                   aux: Optional[_WindowAux],
+                   step_lag=None) -> Dict[str, Any]:
         """One window row back to the exact reply dict the scalar JSON
         path built: per-status key set, raw id echo, untruncated
-        reasons, trace id on sampled replies."""
+        reasons, trace id on sampled replies; replica-served reads carry
+        `replica`/`step_lag` exactly as a version-3 binary record's
+        reply_to_dict does."""
         st = int(status[r])
         rid = aux.raw_ids.get(r, _MISSING) if aux is not None else _MISSING
         rep: Dict[str, Any] = {
@@ -633,6 +645,9 @@ class GatewayServer:
         if st == frames.ST_OK:
             rep["status"] = "ok"
             rep["value"] = float(value[r])
+            if step_lag is not None and int(step_lag[r]) >= 0:
+                rep["replica"] = True
+                rep["step_lag"] = int(step_lag[r])
         else:
             rep["status"] = "shed" if st == frames.ST_SHED else "error"
             full = aux.reasons_full.get(r) if aux is not None else None
@@ -694,6 +709,7 @@ class GatewayServer:
         reason = np.zeros((n,), f"S{frames.REASON_BYTES}")
         value = np.zeros((n,), np.float64)
         retry = np.zeros((n,), np.uint32)
+        step_lag = np.full((n,), -1, np.int32)  # >=0 <=> replica-served
 
         tr = self._tracer
         traces = None
@@ -731,11 +747,13 @@ class GatewayServer:
 
         slo_outcomes: Dict[bytes, List[str]] = {}
         slo_lat: Dict[bytes, List[Optional[float]]] = {}
+        slo_rep: Dict[bytes, List[bool]] = {}
 
         def note(t: bytes, outcome: str, lat: Optional[float] = None,
-                 count: int = 1) -> None:
+                 count: int = 1, replica: bool = False) -> None:
             slo_outcomes.setdefault(t, []).extend([outcome] * count)
             slo_lat.setdefault(t, []).extend([lat] * count)
+            slo_rep.setdefault(t, []).extend([replica] * count)
 
         def set_reason(i, full: str) -> None:
             # wire truncation on the column; JSON replies keep the full
@@ -789,8 +807,37 @@ class GatewayServer:
         for i in np.nonzero(missing)[0]:
             note(tenants[i], "error")
 
-        # ---- ONE ask wave for the whole admitted window
+        # ---- replicated read path (ISSUE 14): hot-entity gets answered
+        # from the local replica BEFORE the ask wave, strictly after the
+        # admission charge (sheds/charging identical to the wave path);
+        # stale-beyond-bound and cold entities fall through to the wave
         serve = np.nonzero(admitted & known)[0]
+        cache = self.replica_cache
+        if cache is not None and len(serve):
+            t0r = time.perf_counter()
+            replica_rows: List[int] = []
+            for i in serve:
+                if ops[i] != frames.OP_GET:
+                    continue
+                hit = cache.try_read(entities[i].decode("utf-8"))
+                if hit is None:
+                    continue
+                status[i] = frames.ST_OK
+                value[i], step_lag[i] = hit[0], hit[1]
+                replica_rows.append(int(i))
+            if replica_rows:
+                dtr = time.perf_counter() - t0r
+                for i in replica_rows:
+                    note(tenants[i], "ok", dtr, replica=True)
+                    sp = roots.get(i)
+                    if sp is not None:  # parented under gw.request; the
+                        # fall-through rows keep their ask.member spans
+                        tr.emit("gw.replica_read", sp.ctx, t0=t0r,
+                                t1=t0r + dtr, step_lag=int(step_lag[i]))
+                keep = ~np.isin(serve, replica_rows)
+                serve = serve[keep]
+
+        # ---- ONE ask wave for the whole admitted window
         if len(serve):
             vals = np.where(ops[serve] == frames.OP_ADD,
                             rec["value"][serve].astype(np.float64), 0.0)
@@ -803,7 +850,8 @@ class GatewayServer:
             outcomes = self._backend_ask_many(ents, vals, ctxs)
             dt = time.perf_counter() - t0
             pool_noted = False
-            for i, outc in zip(serve, outcomes):
+            wave_totals: Dict[str, float] = {}
+            for i, outc, ent in zip(serve, outcomes, ents):
                 t = tenants[i]
                 if isinstance(outc, AskPoolExhausted):
                     if not pool_noted:
@@ -823,9 +871,18 @@ class GatewayServer:
                     status[i] = frames.ST_OK
                     value[i] = outc
                     note(t, "ok", dt)
+                    # last ok outcome per entity wins: rows are in wave
+                    # linearization order, so this IS the post-wave total
+                    wave_totals[ent] = float(outc)
+            if cache is not None and wave_totals:
+                # ONE batched publish per ask wave (the coalesced-flush
+                # boundary): authoritative totals re-arm the replica —
+                # including for reads that just fell through as stale
+                cache.publish_wave(wave_totals)
 
         for t, outs in slo_outcomes.items():
-            self.slo.record_many(t.decode("utf-8"), outs, slo_lat[t])
+            self.slo.record_many(t.decode("utf-8"), outs, slo_lat[t],
+                                 slo_rep[t])
         if roots:
             st_names = {frames.ST_OK: "ok", frames.ST_SHED: "shed",
                         frames.ST_ERROR: "error"}
@@ -836,7 +893,7 @@ class GatewayServer:
                     .decode("utf-8", "replace")
                 sp.finish(status=st_names.get(int(status[i]), "error"),
                           **({"reason": rsn} if rsn else {}))
-        return ids, status, reason, value, retry, traces
+        return ids, status, reason, value, retry, traces, step_lag
 
     def _backend_ask_many(self, entity_ids: List[str],
                           values: np.ndarray,

@@ -59,10 +59,17 @@ class SloTracker:
         self._lat_ms: deque = deque(maxlen=int(window))
         self._lat_seq = 0
         self._lat_sorted = (-1, [])
+        # replicated-read split (ISSUE 14): replica-served and
+        # authoritative latencies in their own windows so artifact() can
+        # report both percentile families; `_lat_ms` stays ALL admitted
+        # traffic — existing consumers see identical numbers
+        self._lat_rep: deque = deque(maxlen=int(window))
+        self._lat_auth: deque = deque(maxlen=int(window))
         self._hist = None
         self._batcher = None
         self._aggregator = None
         self._autoscaler = None
+        self._replica_cache = None
         if registry is not None:
             registry.register_collector("gateway", self._collect)
             self._hist = registry.histogram(
@@ -88,6 +95,15 @@ class SloTracker:
         (docs/OBSERVABILITY.md); this is the stable-schema summary."""
         self._aggregator = aggregator
 
+    def attach_replica_cache(self, cache) -> None:
+        """Carry the replicated-read summary (ReadReplicaCache.stats:
+        promotions, replica_served, fall-throughs, staleness_bound_held)
+        in artifact() as `replica_reads`, WITH the replicated-vs-
+        authoritative percentile split — the number the hot-key
+        read-storm bench leg asserts. Same stable-schema-summary
+        contract as `ask_batch`."""
+        self._replica_cache = cache
+
     def attach_autoscaler(self, autoscaler) -> None:
         """Carry the elastic-mesh summary (MeshAutoscaler.stats: widened/
         narrowed counts, current width, last trigger signal and pause) in
@@ -109,18 +125,23 @@ class SloTracker:
             per[outcome] += 1
             if latency_s is not None:
                 self._lat_ms.append(latency_s * 1e3)
+                self._lat_auth.append(latency_s * 1e3)
                 self._lat_seq += 1
         if self._hist is not None and latency_s is not None:
             step = self.registry.step if self.registry is not None else None
             self._hist.observe(latency_s * 1e3, step=step)
 
-    def record_many(self, tenant: str, outcomes, latencies_s=None) -> None:
+    def record_many(self, tenant: str, outcomes, latencies_s=None,
+                    replica_flags=None) -> None:
         """Wave recording for the batch-decoded ingress path: all of one
         tenant's outcomes from a reply wave under ONE lock acquisition,
         with the latency histogram fed in one vectorized observe.
         `outcomes` is a sequence of outcome names; `latencies_s` (same
         length or None) carries per-request latencies, None entries
-        skipped — counter parity with N record() calls is exact."""
+        skipped — counter parity with N record() calls is exact.
+        `replica_flags` (ISSUE 14, same length or None) marks replica-
+        served requests so their latencies land in the split windows;
+        omitted ⇒ everything counts authoritative."""
         counts: Dict[str, int] = {}
         for o in outcomes:
             if o not in _OUTCOMES:
@@ -129,6 +150,14 @@ class SloTracker:
         if not counts:
             return
         lats = [s for s in (latencies_s or ()) if s is not None]
+        rep_lats: list = []
+        auth_lats: list = []
+        if latencies_s is not None:
+            flags = replica_flags or (False,) * len(outcomes)
+            for s, f in zip(latencies_s, flags):
+                if s is None:
+                    continue
+                (rep_lats if f else auth_lats).append(s * 1e3)
         with self._lock:
             per = self._per_tenant.get(tenant)
             if per is None:
@@ -139,6 +168,8 @@ class SloTracker:
             if lats:
                 self._lat_ms.extend(s * 1e3 for s in lats)
                 self._lat_seq += len(lats)
+                self._lat_rep.extend(rep_lats)
+                self._lat_auth.extend(auth_lats)
         if self._hist is not None and lats:
             step = self.registry.step if self.registry is not None else None
             self._hist.observe_many([s * 1e3 for s in lats], step=step)
@@ -154,6 +185,22 @@ class SloTracker:
         if not d:
             return 0.0
         return d[max(math.ceil(q * len(d)) - 1, 0)]
+
+    def _split_percentiles(self) -> Dict[str, float]:
+        """p50/p99 of the replica-served and authoritative windows (the
+        replicated-read split). Sorted on demand — this is exposition-
+        time only (artifact/bench), never the hot path."""
+        with self._lock:
+            rep = sorted(self._lat_rep)
+            auth = sorted(self._lat_auth)
+
+        def pick(d, q):
+            return d[max(math.ceil(q * len(d)) - 1, 0)] if d else 0.0
+        return {"replica_p50_ms": round(pick(rep, 0.50), 3),
+                "replica_p99_ms": round(pick(rep, 0.99), 3),
+                "auth_p50_ms": round(pick(auth, 0.50), 3),
+                "auth_p99_ms": round(pick(auth, 0.99), 3),
+                "replica_lat_n": len(rep), "auth_lat_n": len(auth)}
 
     # -------------------------------------------------------------- report
     def artifact(self) -> Dict[str, Any]:
@@ -172,10 +219,15 @@ class SloTracker:
                   if self._aggregator is not None else {})
         scale = ({"autoscale": self._autoscaler.stats()}
                  if self._autoscaler is not None else {})
+        replica = {}
+        if self._replica_cache is not None:
+            replica = {"replica_reads": {**self._replica_cache.stats(),
+                                         **self._split_percentiles()}}
         return {
             **batch,
             **ingest,
             **scale,
+            **replica,
             "requests": total,
             "ok": counts["ok"],
             "rejects": counts["reject"],

@@ -227,6 +227,13 @@ def encode_value(obj: Any, out: io.BytesIO,
     elif _is_actor_ref(obj):
         out.write(b"r")
         _w_str(out, ref_wire_path(obj))
+    elif isinstance(obj, type):
+        # class REFERENCE (not instance): e.g. the zero_tag a map delta op
+        # carries so first-sight replicas reconstruct the right wrapper.
+        # Decode goes through _resolve_class, so only trusted/registered
+        # classes ever resolve.
+        out.write(b"C")
+        _w_str(out, _class_key(obj))
     elif isinstance(obj, tuple) and hasattr(type(obj), "_fields"):
         # NamedTuple: state lives in the tuple payload, not __dict__
         cls = type(obj)
@@ -374,6 +381,8 @@ def decode_value(inp: io.BytesIO, memo: Optional[list] = None) -> Any:
     if tag == b"r":
         from .serialization import resolve_ref
         return resolve_ref(_r_str(inp))
+    if tag == b"C":
+        return _resolve_class(_r_str(inp))
     if tag == b"n":
         cls = _resolve_class(_r_str(inp))
         (n,) = _U32.unpack(_read_exact(inp, 4))

@@ -41,6 +41,14 @@ bytes are bit-identical to version 1, and version-1 decoders never see a
 frame they cannot parse unless tracing was deliberately turned on.
 Request frames stay version 1.
 
+Replica reply record (version 3, 65 bytes — ISSUE 14): version 2's
+fields plus a trailing `step_lag i32` — ≥ 0 marks a replica-served read
+(the value is its bounded staleness in device steps on the shared
+ATT_STEP axis), −1 marks the authoritative wave path. Version 3 is
+emitted ONLY when some record in the wave was actually replica-served,
+mirroring the version-2 discipline: a gateway without a replica cache
+(or a wave with no replica hits) never changes the wire.
+
 String fields are NUL-padded UTF-8; a reason longer than 32 bytes is
 truncated (every typed gateway reason fits). A batch of one is the solo
 ask — bit-identical semantics to its JSON twin, tested in
@@ -61,10 +69,12 @@ import numpy as np
 
 from .codec import _U32
 
-__all__ = ["MAGIC", "VERSION", "VERSION_TRACED", "KIND_REQUEST",
+__all__ = ["MAGIC", "VERSION", "VERSION_TRACED", "VERSION_REPLICA",
+           "KIND_REQUEST",
            "KIND_REPLY", "OP_GET", "OP_ADD", "OP_NAMES", "OP_CODES",
            "ST_OK", "ST_SHED", "ST_ERROR",
            "REQUEST_DTYPE", "REPLY_DTYPE", "REPLY_DTYPE_TRACED",
+           "REPLY_DTYPE_REPLICA",
            "DEFAULT_MAX_FRAME",
            "FrameFormatError", "is_binary", "frame",
            "encode_request_batch", "decode_request_batch",
@@ -75,6 +85,7 @@ __all__ = ["MAGIC", "VERSION", "VERSION_TRACED", "KIND_REQUEST",
 MAGIC = 0xAB
 VERSION = 1
 VERSION_TRACED = 2  # replies only: VERSION layout + trailing trace u64
+VERSION_REPLICA = 3  # replies only: VERSION_TRACED layout + step_lag i32
 KIND_REQUEST = 0
 KIND_REPLY = 1
 
@@ -112,6 +123,12 @@ REPLY_DTYPE = np.dtype([("id", ">i8"), ("status", "u1"),
 
 # version-2 reply record: version 1 + the causal trace id (ISSUE 12)
 REPLY_DTYPE_TRACED = np.dtype(REPLY_DTYPE.descr + [("trace", ">u8")])
+
+# version-3 reply record: version 2 + the replica step-lag marker
+# (ISSUE 14): step_lag >= 0 <=> served from the read replica, that many
+# device steps behind the authoritative state; -1 <=> wave path
+REPLY_DTYPE_REPLICA = np.dtype(REPLY_DTYPE_TRACED.descr
+                               + [("step_lag", ">i4")])
 
 
 class FrameFormatError(ValueError):
@@ -248,22 +265,39 @@ def decode_request_batches(bodies: Sequence[bytes],
 def encode_reply_batch(ids: np.ndarray, statuses: np.ndarray,
                        reasons: np.ndarray, values: np.ndarray,
                        retry_after_ms: np.ndarray,
-                       traces: Any = None) -> bytes:
+                       traces: Any = None,
+                       step_lags: Any = None) -> bytes:
     """Encode a whole reply wave in one vectorized pass (columns in,
     bytes out — the readback twin of decode_request_batch).
 
     `traces` (ISSUE 12): optional aligned u64 trace-id column. When any
     id is nonzero the wave is encoded as version 2 (trailing trace
     field); otherwise the output is bit-identical to the pre-tracing
-    version-1 bytes — an untraced server never changes the wire."""
+    version-1 bytes — an untraced server never changes the wire.
+
+    `step_lags` (ISSUE 14): optional aligned i32 replica-marker column
+    (−1 = authoritative, ≥ 0 = replica-served at that step lag). When
+    any row was replica-served the wave is version 3 (trace column
+    included, zeros if untraced); otherwise the column is dropped and
+    the version-2/1 rules above apply unchanged."""
     n = len(ids)
     traced = traces is not None and bool(np.any(np.asarray(traces)))
-    rec = np.zeros((n,), REPLY_DTYPE_TRACED if traced else REPLY_DTYPE)
+    replica = step_lags is not None and \
+        bool(np.any(np.asarray(step_lags) >= 0))
+    if replica:
+        rec = np.zeros((n,), REPLY_DTYPE_REPLICA)
+    else:
+        rec = np.zeros((n,), REPLY_DTYPE_TRACED if traced else REPLY_DTYPE)
     rec["id"] = ids
     rec["status"] = statuses
     rec["reason"] = reasons
     rec["value"] = values
     rec["retry_after_ms"] = retry_after_ms
+    if replica:
+        if traced:
+            rec["trace"] = np.asarray(traces, np.uint64)
+        rec["step_lag"] = np.asarray(step_lags, np.int32)
+        return _header(KIND_REPLY, n, VERSION_REPLICA) + rec.tobytes()
     if traced:
         rec["trace"] = np.asarray(traces, np.uint64)
         return _header(KIND_REPLY, n, VERSION_TRACED) + rec.tobytes()
@@ -275,6 +309,9 @@ def decode_reply_batch(body: bytes,
     """Decode a reply wave to its record columns (client half). Accepts
     both reply versions: 1 (53B records) and 2 (61B traced records) —
     the record array's dtype tells the caller which it got."""
+    if len(body) >= 2 and body[1] == VERSION_REPLICA:
+        return _decode_records(body, KIND_REPLY, REPLY_DTYPE_REPLICA,
+                               max_frame, VERSION_REPLICA)
     if len(body) >= 2 and body[1] == VERSION_TRACED:
         return _decode_records(body, KIND_REPLY, REPLY_DTYPE_TRACED,
                                max_frame, VERSION_TRACED)
@@ -297,6 +334,9 @@ def reply_to_dict(rec) -> Dict[str, Any]:
         out["reason"] = bytes(rec["reason"]).decode("utf-8", "replace")
     if "trace" in (rec.dtype.names or ()) and int(rec["trace"]):
         out["trace"] = int(rec["trace"])
+    if "step_lag" in (rec.dtype.names or ()) and int(rec["step_lag"]) >= 0:
+        out["replica"] = True
+        out["step_lag"] = int(rec["step_lag"])
     return out
 
 

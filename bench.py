@@ -1416,6 +1416,108 @@ def bench_gateway_ingest_ab(region, per_leg: int = 384):
                    and j["aggregated"]["mean_window_size"] > 1.0)}
 
 
+def bench_gateway_replica_ab(region, per_leg: int = 384):
+    """Hot-key read-storm A/B (ISSUE 14 acceptance): 64 clients, a 90/10
+    get/add mix zipf-skewed onto a handful of celebrity keys, through
+    handle_frame with the ReadReplicaCache on vs off, equal admission
+    (wide open both legs) on one shared warm region. The replicated leg
+    answers hot gets from the local replica BEFORE the ask wave under
+    the bounded-staleness contract (writes stay linearized through the
+    wave; every wave re-publishes its post-wave totals). Acceptance:
+    replicated read p99 <= 0.5x authoritative at equal admission AND
+    the staleness bound held (fall-throughs are allowed — violations
+    are impossible by construction and asserted anyway)."""
+    import threading as _threading
+
+    from akka_tpu.gateway import (AdmissionController, GatewayServer,
+                                  RegionBackend, SloTracker)
+    from akka_tpu.gateway.replica import ReadReplicaCache
+
+    clients = 64
+    per_client = max(10, per_leg // clients)
+    hot_keys = 4
+
+    def entity_of(w: int, i: int) -> str:
+        # deterministic zipf-ish skew: ~85% of traffic hammers the
+        # `hot_keys` celebrity set, the tail spreads over 48 cold keys
+        r = (w * 2654435761 + i * 40503) % 100
+        if r < 85:
+            return f"celeb-{r % hot_keys}"
+        return f"tail-{(w * 7 + i) % 48}"
+
+    def leg(replicated: bool):
+        backend = RegionBackend(region, max_batch=64)
+        slo = SloTracker(target_p50_ms=50.0, target_p99_ms=250.0)
+        adm = AdmissionController(rate=1e9, burst=1e9)
+        cache = None
+        if replicated:
+            cache = ReadReplicaCache(
+                lambda: region.system._host_step, hot_hits=2,
+                hot_window_s=30.0, hot_ttl_s=30.0)
+        srv = GatewayServer(None, backend, adm, slo, replica_cache=cache)
+        not_ok = []
+
+        def worker(w: int):
+            for i in range(per_client):
+                op = "add" if i % 10 == 0 else "get"  # 90/10 read/write
+                rep = json.loads(srv.handle_frame(json.dumps(
+                    {"id": w * per_client + i, "tenant": f"t{w % 4}",
+                     "entity": entity_of(w, i), "op": op,
+                     "value": float(i % 5 + 1)}).encode()))
+                if rep["status"] != "ok":
+                    not_ok.append(rep["status"])
+
+        threads = [_threading.Thread(target=worker, args=(w,))
+                   for w in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        n = per_client * clients
+        art = slo.artifact()
+        backend.close()
+        row = {"leg": "replicated" if replicated else "authoritative",
+               "clients": clients, "requests": n,
+               "wall_s": round(dt, 3), "req_per_sec": round(n / dt, 1),
+               "not_ok": len(not_ok), "admitted": adm.admitted,
+               "rejected": adm.rejected,
+               "p50_ms": art["p50_ms"], "p99_ms": art["p99_ms"]}
+        if replicated:
+            rr = art["replica_reads"]
+            row.update(
+                replica_served=rr["replica_served"],
+                fallthrough_stale=rr["fallthrough_stale"],
+                fallthrough_cold=rr["fallthrough_cold"],
+                promotions=rr["promotions"],
+                max_served_lag=rr["max_served_lag"],
+                staleness_bound_held=rr["staleness_bound_held"],
+                replica_p50_ms=rr["replica_p50_ms"],
+                replica_p99_ms=rr["replica_p99_ms"],
+                auth_p50_ms=rr["auth_p50_ms"],
+                auth_p99_ms=rr["auth_p99_ms"])
+        try:
+            row["host_loadavg"] = round(os.getloadavg()[0], 2)
+        except OSError:
+            pass
+        return row
+
+    auth, rep = leg(False), leg(True)
+    # the acceptance ratio: p99 of REPLICA-SERVED reads vs the p99 of
+    # the authoritative leg's identical admitted mix
+    ratio = round(rep["replica_p99_ms"] / max(auth["p99_ms"], 1e-9), 3)
+    return {"authoritative": auth, "replicated": rep,
+            "replica_p99_ratio": ratio,
+            "speedup": round(rep["req_per_sec"]
+                             / max(auth["req_per_sec"], 1e-9), 2),
+            "equal_admission": (auth["admitted"] == rep["admitted"]
+                                and auth["rejected"] == rep["rejected"]
+                                == 0),
+            "ok": (ratio <= 0.5 and rep["replica_served"] > 0
+                   and rep["staleness_bound_held"] == 1)}
+
+
 def bench_tracing_overhead(region, per_leg: int = 384):
     """tracing-overhead (ISSUE 12): the gateway 64-client batched leg
     (same mix as bench_gateway_concurrency) run three ways on one shared
@@ -1696,12 +1798,14 @@ def bench_gateway_slo(n_requests: int = 400, n_entities: int = 16):
     concurrency = bench_gateway_concurrency(region)
     binary_ab = bench_gateway_binary_ab(region, per_leg=n_requests)
     ingest_ab = bench_gateway_ingest_ab(region, per_leg=n_requests)
+    replica_ab = bench_gateway_replica_ab(region, per_leg=n_requests)
     return {"below_threshold": below, "overload": over,
             "entities_total": round(total, 1),
             "shed_working": over["rejects"] > 0 and below["rejects"] == 0,
             "concurrency": concurrency,
             "binary_ab": binary_ab,
-            "ingest_ab": ingest_ab}
+            "ingest_ab": ingest_ab,
+            "replica_ab": replica_ab}
 
 
 def main() -> None:
@@ -2020,6 +2124,7 @@ def main() -> None:
                 b, o = out["below_threshold"], out["overload"]
                 ab = out["binary_ab"]
                 ia = out["ingest_ab"]
+                ra = out["replica_ab"]
                 print(f"[bench] gateway-slo: p50={b['p50_ms']}ms "
                       f"p99={b['p99_ms']}ms @{b['req_per_sec']}req/s | "
                       f"overload reject_rate={o['reject_rate']} "
@@ -2028,7 +2133,9 @@ def main() -> None:
                       f"{'OK' if ab['ok'] else 'FAIL'} | "
                       f"ingest x{ia['speedup']} "
                       f"win={ia['mean_window_size']} "
-                      f"{'OK' if ia['ok'] else 'FAIL'}",
+                      f"{'OK' if ia['ok'] else 'FAIL'} | "
+                      f"replica p99 ratio={ra['replica_p99_ratio']} "
+                      f"{'OK' if ra['ok'] else 'FAIL'}",
                       file=sys.stderr)
                 print(json.dumps({
                     "metric": "gateway serving latency p99, sustained load "

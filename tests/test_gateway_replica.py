@@ -1,0 +1,272 @@
+"""Replicated hot-key read path (gateway/replica.py + the ingress hook,
+ISSUE 14): hit-count promotion with TTL demotion, the bounded-staleness
+contract (served lag can never exceed `max_step_lag` — stale reads fall
+through to the authoritative wave), reply markers on both encodings
+(JSON `replica`/`step_lag` keys, binary version-3 records), the SLO
+artifact's replicated-vs-authoritative percentile split, and the
+two-node ddata feed.
+
+Tier-1 scope: unit tests drive ReadReplicaCache with an injected step
+clock; the gateway tests ride a module region of the SAME spec shape as
+test_gateway_binary's (2 shards x 8 eps, payload width 4 — warm jit
+cache, <= 64-row waves); the two-node test uses the in-proc transport
+like tests/test_ddata.py."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from akka_tpu import ActorSystem
+from akka_tpu.gateway import (AdmissionController, GatewayServer,
+                              RegionBackend, SloTracker, counter_behavior)
+from akka_tpu.gateway.ingress import encode_body
+from akka_tpu.gateway.replica import ReadReplicaCache
+from akka_tpu.serialization import frames
+
+
+class StepClock:
+    """Injected ATT_STEP axis: staleness is deterministic in tests."""
+
+    def __init__(self, step: int = 0):
+        self.step = step
+
+    def __call__(self) -> int:
+        return self.step
+
+    def advance(self, n: int = 1) -> None:
+        self.step += n
+
+
+# ------------------------------------------------------------- cache unit
+def test_replica_promotion_then_ttl_demotion():
+    clk = StepClock()
+    c = ReadReplicaCache(clk, hot_hits=3, hot_window_s=10.0, hot_ttl_s=0.05)
+    c.publish_wave({"e": 5.0})
+    # two hits inside the window: still cold, both fall through
+    assert c.try_read("e") is None
+    assert c.try_read("e") is None
+    assert not c.is_hot("e")
+    # third hit promotes AND serves (fresh: lag 0)
+    assert c.try_read("e") == (5.0, 0)
+    assert c.is_hot("e")
+    st = c.stats()
+    assert st["promotions"] == 1 and st["replica_served"] == 1
+    assert st["gets"] == 3 and st["fallthrough_cold"] == 0
+    # no hits past the TTL: demoted, the next get falls through again
+    time.sleep(0.08)
+    assert c.try_read("e") is None
+    st = c.stats()
+    assert st["demotions"] == 1 and not c.is_hot("e")
+
+
+def test_replica_staleness_bound_is_unexceedable():
+    clk = StepClock()
+    c = ReadReplicaCache(clk, hot_hits=1, max_step_lag=4)
+    c.publish_wave({"e": 2.0})
+    assert c.try_read("e") == (2.0, 0)
+    clk.advance(4)  # exactly at the bound: still served
+    assert c.try_read("e") == (2.0, 4)
+    clk.advance(1)  # past the bound: falls through, NOT a violation
+    assert c.try_read("e") is None
+    st = c.stats()
+    assert st["fallthrough_stale"] == 1 and st["max_served_lag"] == 4
+    assert st["staleness_violations"] == 0
+    assert st["staleness_bound_held"] == 1
+    # an authoritative publish re-arms the entity
+    c.publish_wave({"e": 3.0})
+    assert c.try_read("e") == (3.0, 0)
+
+
+def test_replica_hot_but_unpublished_falls_through_cold():
+    c = ReadReplicaCache(StepClock(), hot_hits=1)
+    assert c.try_read("never-published") is None
+    assert c.stats()["fallthrough_cold"] == 1
+
+
+def test_replica_window_expiry_resets_promotion_count():
+    clk = StepClock()
+    c = ReadReplicaCache(clk, hot_hits=2, hot_window_s=0.02)
+    c.publish_wave({"e": 1.0})
+    assert c.try_read("e") is None  # hit 1
+    time.sleep(0.04)  # window expires: the count restarts
+    assert c.try_read("e") is None  # hit 1 again, not 2
+    assert c.try_read("e") == (1.0, 0)  # hit 2 inside the fresh window
+    assert c.stats()["promotions"] == 1
+
+
+# ------------------------------------------------------ gateway integration
+@pytest.fixture(scope="module")
+def small_region():
+    from akka_tpu.sharding.device import DeviceEntity, DeviceShardRegion
+    spec = DeviceEntity("gwr", counter_behavior(4), n_shards=2,
+                        entities_per_shard=8, n_devices=2, payload_width=4)
+    return DeviceShardRegion(spec)
+
+
+def _req(server, tenant, entity, op, value=0.0, rid=1):
+    body = encode_body({"id": rid, "tenant": tenant, "entity": entity,
+                        "op": op, "value": value})
+    return json.loads(server.handle_frame(body))
+
+
+def _replica_server(region, clk, **cache_kw):
+    cache = ReadReplicaCache(clk, hot_hits=cache_kw.pop("hot_hits", 2),
+                             hot_window_s=30.0, hot_ttl_s=30.0, **cache_kw)
+    slo = SloTracker()
+    srv = GatewayServer(None, RegionBackend(region),
+                        AdmissionController(rate=1e6, burst=1e6), slo,
+                        replica_cache=cache)
+    return srv, cache, slo
+
+
+def test_gateway_replica_served_get_json_markers(small_region):
+    """Writes keep the linearized wave path; a hot get is answered from
+    the replica BEFORE the wave and its reply says so (`replica` +
+    `step_lag`); authoritative replies carry neither key."""
+    srv, cache, slo = _replica_server(small_region, StepClock())
+    rep = _req(srv, "t0", "hot-a", "add", 2.5, rid=1)
+    assert rep["status"] == "ok" and rep["value"] == pytest.approx(2.5)
+    assert "replica" not in rep  # the wave publish rode this add
+    rep = _req(srv, "t0", "hot-a", "get", rid=2)  # hit 1: authoritative
+    assert rep["value"] == pytest.approx(2.5) and "replica" not in rep
+    rep = _req(srv, "t0", "hot-a", "get", rid=3)  # hit 2: promoted
+    assert rep["status"] == "ok" and rep["value"] == pytest.approx(2.5)
+    assert rep["replica"] is True and rep["step_lag"] == 0
+    # a write to the now-hot entity still linearizes through the wave,
+    # and its post-wave total re-arms the replica
+    rep = _req(srv, "t0", "hot-a", "add", 1.5, rid=4)
+    assert rep["value"] == pytest.approx(4.0) and "replica" not in rep
+    rep = _req(srv, "t0", "hot-a", "get", rid=5)
+    assert rep["replica"] is True and rep["value"] == pytest.approx(4.0)
+    st = cache.stats()
+    assert st["replica_served"] == 2 and st["staleness_bound_held"] == 1
+    assert st["publishes"] == 3  # add, authoritative get, add
+
+
+def test_gateway_replica_slo_artifact_split(small_region):
+    srv, cache, slo = _replica_server(small_region, StepClock())
+    for i in range(2):
+        assert _req(srv, "t0", "hot-s", "add", 1.0,
+                    rid=i)["status"] == "ok"
+    got_replica = 0
+    for i in range(4):
+        rep = _req(srv, "t0", "hot-s", "get", rid=10 + i)
+        got_replica += int(rep.get("replica", False))
+    assert got_replica == 3  # hit 1 authoritative, hits 2-4 replica
+    art = slo.artifact()
+    rr = art["replica_reads"]
+    assert rr["replica_served"] == 3 and rr["staleness_bound_held"] == 1
+    assert rr["replica_lat_n"] == 3 and rr["auth_lat_n"] == 3
+    assert rr["replica_p99_ms"] > 0 and rr["auth_p99_ms"] > 0
+    assert rr["promotions"] == 1 and rr["max_served_lag"] == 0
+    # the unsplit window still carries ALL admitted traffic
+    assert art["ok"] == 6 and art["requests"] == 6
+
+
+def test_gateway_replica_staleness_fallthrough_self_heals(small_region):
+    """Device steps advancing without a publish push the entity past the
+    bound: the get falls through to the wave, whose publish re-arms the
+    replica — the bound is enforced, never violated."""
+    clk = StepClock()
+    srv, cache, slo = _replica_server(small_region, clk, hot_hits=1,
+                                      max_step_lag=4)
+    assert _req(srv, "t0", "hot-f", "add", 3.0, rid=1)["status"] == "ok"
+    rep = _req(srv, "t0", "hot-f", "get", rid=2)
+    assert rep["replica"] is True and rep["step_lag"] == 0
+    clk.advance(10)  # steps moved, no publish: stale beyond the bound
+    rep = _req(srv, "t0", "hot-f", "get", rid=3)
+    assert rep["status"] == "ok" and "replica" not in rep
+    st = cache.stats()
+    assert st["fallthrough_stale"] == 1 and st["staleness_violations"] == 0
+    rep = _req(srv, "t0", "hot-f", "get", rid=4)  # re-armed at the wave
+    assert rep["replica"] is True and rep["step_lag"] == 0
+
+
+def test_gateway_replica_binary_version3_records(small_region):
+    """A reply wave with a replica-served row ships version-3 records
+    (step_lag column, -1 on authoritative rows); a wave without one
+    keeps the seed encodings byte-for-byte."""
+    srv, cache, slo = _replica_server(small_region, StepClock(),
+                                      hot_hits=1)
+    assert _req(srv, "t0", "hot-b", "add", 6.0, rid=1)["status"] == "ok"
+    # mixed window: a replica-served get + an authoritative add
+    body = frames.encode_request_batch(
+        [2, 3], ["t0", "t0"], ["hot-b", "cold-b"],
+        [frames.OP_GET, frames.OP_ADD], [0.0, 1.0])
+    rec = frames.decode_reply_batch(srv.handle_binary(body))
+    assert "step_lag" in (rec.dtype.names or ())
+    assert rec["step_lag"].tolist() == [0, -1]
+    got, added = [frames.reply_to_dict(r) for r in rec]
+    assert got == {"id": 2, "status": "ok", "value": pytest.approx(6.0),
+                   "replica": True, "step_lag": 0}
+    assert added["id"] == 3 and "replica" not in added
+    # no replica-served rows => no step_lag column (version 1 bytes)
+    body = frames.encode_request_batch([4], ["t0"], ["cold-b"],
+                                       [frames.OP_ADD], [1.0])
+    rec = frames.decode_reply_batch(srv.handle_binary(body))
+    assert "step_lag" not in (rec.dtype.names or ())
+
+
+# ----------------------------------------------------------- two-node feed
+FAST = {"akka": {"actor": {"provider": "cluster"},
+                 "stdout-loglevel": "OFF", "log-dead-letters": 0,
+                 "remote": {"transport": "inproc",
+                            "canonical": {"hostname": "local", "port": 0}},
+                 "cluster": {"gossip-interval": "0.05s",
+                             "leader-actions-interval": "0.05s",
+                             "unreachable-nodes-reaper-interval": "0.1s",
+                             "failure-detector": {
+                                 "heartbeat-interval": "0.1s",
+                                 "acceptable-heartbeat-pause": "2s"},
+                             "distributed-data": {
+                                 "gossip-interval": "0.1s",
+                                 "notify-subscribers-interval": "0.05s",
+                                 "pruning-interval": "0.3s",
+                                 "delta-crdt": {
+                                     "delta-propagation-interval":
+                                         "0.05s"}}}}}
+
+
+def test_replica_cache_two_node_ddata_feed():
+    """A publish on gateway A reaches gateway B's cache through the
+    replicator subscription (op deltas over the in-proc transport) and
+    serves under B's own staleness clock."""
+    from akka_tpu.cluster import Cluster
+    from akka_tpu.remote.transport import InProcTransport
+    from akka_tpu.testkit import await_condition
+    InProcTransport.fault_injector.reset()
+    systems = [ActorSystem.create(f"gwrep{i}", FAST) for i in range(2)]
+    try:
+        clusters = [Cluster.get(s) for s in systems]
+        first = str(systems[0].provider.local_address)
+        for c in clusters:
+            c.join(first)
+        await_condition(
+            lambda: all(len([m for m in c.state.members
+                             if m.status.value == "Up"]) == 2
+                        for c in clusters), max_time=10.0)
+        clk_a, clk_b = StepClock(5), StepClock(5)
+        a = ReadReplicaCache(clk_a, system=systems[0], hot_hits=1)
+        b = ReadReplicaCache(clk_b, system=systems[1], hot_hits=1)
+        assert a.stats()["replicated"] and b.stats()["replicated"]
+        a.publish_wave({"acct": 7.5})
+        await_condition(lambda: "acct" in b._replica, max_time=10.0)
+        assert b.try_read("acct") == (pytest.approx(7.5), 0)
+        # a later publish (larger total, later step) supersedes on B
+        clk_a.advance(2)
+        clk_b.advance(2)
+        a.publish_wave({"acct": 9.0})
+        await_condition(
+            lambda: b._replica.get("acct", (0, 0))[0] ==
+            pytest.approx(9.0), max_time=10.0)
+        assert b.try_read("acct") == (pytest.approx(9.0), 0)
+        assert b.stats()["staleness_bound_held"] == 1
+    finally:
+        for s in systems:
+            s.terminate()
+        for s in systems:
+            s.await_termination(10.0)
+        InProcTransport.fault_injector.reset()

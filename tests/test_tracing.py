@@ -38,13 +38,14 @@ def region():
     return DeviceShardRegion(spec)
 
 
-def _server(region, tracer, rate=1e9, burst=1e9):
+def _server(region, tracer, rate=1e9, burst=1e9, replica_cache=None):
     from akka_tpu.gateway import (AdmissionController, GatewayServer,
                                   RegionBackend, SloTracker)
     backend = RegionBackend(region, batch=True, max_batch=64)
     srv = GatewayServer(None, backend, AdmissionController(rate=rate,
                                                            burst=burst),
-                        SloTracker(), tracer=tracer)
+                        SloTracker(), tracer=tracer,
+                        replica_cache=replica_cache)
     return srv, backend
 
 
@@ -265,6 +266,51 @@ def test_wave_ids_monotone_across_waves(region):
     ids = sorted(s["wave_id"] for s in tr.of_name("ask.wave"))
     assert len(ids) == 3 and ids == sorted(set(ids))
     assert stats["last_wave_id"] == ids[-1]
+
+
+def test_replica_read_span_parents_under_request_root(region):
+    """A replica-served get emits gw.replica_read parented under ITS
+    gw.request root, carrying the step-lag attribute; a fall-through get
+    keeps the ask.member parenting — and the whole forest stays
+    orphan-free (ISSUE 14 satellite)."""
+    from akka_tpu.gateway.replica import ReadReplicaCache
+    tr = Tracer(sample_rate=1.0, seed=55)
+    cache = ReadReplicaCache(lambda: 0, hot_hits=1, hot_window_s=30.0,
+                             hot_ttl_s=30.0)
+    srv, backend = _server(region, tr, replica_cache=cache)
+    try:
+        def req(rid, entity, op, value=0.0):
+            return json.loads(srv.handle_frame(json.dumps(
+                {"id": rid, "tenant": "t0", "entity": entity, "op": op,
+                 "value": value}).encode()))
+
+        assert req(1, "rr-a", "add", 2.0)["status"] == "ok"
+        rep = req(2, "rr-a", "get")  # hot + published: replica-served
+        assert rep["replica"] is True and rep["step_lag"] == 0
+        cold = req(3, "rr-cold", "get")  # hot but never published:
+        assert "replica" not in cold     # falls through to the wave
+    finally:
+        backend.close()
+    spans = tr.spans()
+    by_id = {(s["trace"], s["span"]): s for s in spans}
+    for s in spans:
+        if s["parent"]:
+            assert (s["trace"], s["parent"]) in by_id, f"orphan: {s}"
+    reads = [s for s in spans if s["name"] == "gw.replica_read"]
+    assert len(reads) == 1
+    assert reads[0]["trace"] == rep["trace"]
+    assert reads[0]["step_lag"] == 0
+    assert by_id[(reads[0]["trace"], reads[0]["parent"])]["name"] == \
+        "gw.request"
+    # the replica-served trace never reached the ask wave...
+    assert not [s for s in spans if s["name"] == "ask.member"
+                and s["trace"] == rep["trace"]]
+    # ...while the fall-through get rode it, parented as always
+    member = [s for s in spans if s["name"] == "ask.member"
+              and s["trace"] == cold["trace"]]
+    assert len(member) == 1
+    assert by_id[(member[0]["trace"], member[0]["parent"])]["name"] == \
+        "gw.request"
 
 
 # ------------------------------------------------------------------ exporter

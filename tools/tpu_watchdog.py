@@ -466,6 +466,30 @@ def on_tpu_found(detail: str) -> None:
                                 .get("req_per_sec"),
                             "mixed_speedup":
                                 ia.get("mixed", {}).get("speedup")})
+            ra = gw.get("replica_ab", {})
+            if ra:
+                # replicated read path (ISSUE 14): hot-key read storm,
+                # 90/10 get/add zipf over a few celebrity keys at 64
+                # clients, ReadReplicaCache on vs off at equal
+                # admission; acceptance is replica-served p99 <= 0.5x
+                # the authoritative leg's AND the staleness bound held
+                # (fall-throughs allowed, violations impossible)
+                rl = ra.get("replicated", {})
+                append_log({"ts": _utcnow(),
+                            "ok": bool(ra.get("ok")) and
+                                  bool(ra.get("equal_admission")),
+                            "detail": "replicated read path "
+                                      "(hot-key storm, equal admission)",
+                            "replica_p99_ratio":
+                                ra.get("replica_p99_ratio"),
+                            "replica_p99_ms": rl.get("replica_p99_ms"),
+                            "authoritative_p99_ms":
+                                ra.get("authoritative", {}).get("p99_ms"),
+                            "replica_served": rl.get("replica_served"),
+                            "max_served_lag": rl.get("max_served_lag"),
+                            "staleness_bound_held":
+                                rl.get("staleness_bound_held"),
+                            "replica_speedup": ra.get("speedup")})
     # wire-decode throughput: batch np.frombuffer vs json.loads, plus the
     # full-path 1/8/64-client encoding sweep (docs/SERVING_GATEWAY.md
     # wire-protocol section)
