@@ -314,6 +314,15 @@ class GatewayServer:
         self.replica_cache = replica_cache
         if replica_cache is not None and slo is not None:
             slo.attach_replica_cache(replica_cache)
+        if replica_cache is not None:
+            # durable-restore seam (ISSUE 15): a region restored before
+            # the gateway came up replayed the entity journal — overwrite
+            # any pre-crash replica entries (local or ddata-fed) with the
+            # acked-frontier totals at the NEW step, before first serve
+            region = getattr(backend, "region", None)
+            replayed = getattr(region, "_durable_replayed_totals", None)
+            if replayed is not None:
+                replica_cache.republish_restored(replayed)
         self.host = host
         self.port = port
         self.max_frame = max_frame
@@ -948,8 +957,29 @@ class GatewayServer:
             if op == "failover":
                 import jax
                 n = int(req.get("value", 1))
-                step = self.backend.region.failover(jax.devices()[:n])
+                region = self.backend.region
+                step = region.failover(jax.devices()[:n])
+                replayed = getattr(region, "_durable_replayed_totals",
+                                   None)
+                if self.replica_cache is not None and replayed is not None:
+                    # failover truncated device state to the acked
+                    # frontier — stale replica entries must not outlive it
+                    self.replica_cache.republish_restored(replayed)
                 return {"id": rid, "status": "ok", "value": float(step)}
+            if op == "durable":
+                region = self.backend.region
+                ej = getattr(region, "_entity_journal", None)
+                data: Dict[str, Any] = {"attached": ej is not None}
+                if ej is not None:
+                    data["journal"] = ej.stats()
+                    data["replayed_entities"] = len(
+                        region._durable_replayed_totals or {})
+                store = getattr(region.spec, "remember_store", None)
+                if store is not None:
+                    data["remembered"] = sum(
+                        len(store.remembered(region.type_name, str(s)))
+                        for s in range(region.spec.n_shards))
+                return {"id": rid, "status": "ok", "data": data}
             return {"id": rid, "status": "error",
                     "reason": f"unknown_admin_op:{op}"}
         except Exception as e:  # noqa: BLE001 — admin faults must reply

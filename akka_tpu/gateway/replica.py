@@ -79,7 +79,8 @@ class ReadReplicaCache:
         self._stats = {"gets": 0, "replica_served": 0, "fallthrough_stale": 0,
                        "fallthrough_cold": 0, "promotions": 0, "demotions": 0,
                        "publishes": 0, "published_entities": 0,
-                       "max_served_lag": 0, "staleness_violations": 0}
+                       "max_served_lag": 0, "staleness_violations": 0,
+                       "restore_republishes": 0}
         self._h_lag = None
         if registry is not None:
             self._h_lag = registry.histogram(
@@ -180,6 +181,26 @@ class ReadReplicaCache:
         self._replicator.tell(
             Update(self._key, PNCounterMap.empty(), WriteLocal(),
                    modify=modify), self._subscriber)
+
+    def republish_restored(self,
+                           totals: Optional[Dict[str, float]]) -> None:
+        """Durable-restore seam: after a restart or in-process failover
+        replays the entity journal, the device rows hold the acked
+        frontier — but this cache (and the replicated map feeding peer
+        gateways) can still hold pre-crash post-wave totals whose step
+        stamps read as FRESH against the restored `_host_step`, because
+        the restored step lands near the crash frontier. Entries the
+        journal covers are re-published at the NEW step (overwriting the
+        stale stamp locally and in the replicated map); entries it does
+        not cover are dropped, since they can only describe pre-crash
+        unacked state — those reads fall through to the wave."""
+        totals = dict(totals) if totals else {}
+        with self._lock:
+            for e in [e for e in self._replica if e not in totals]:
+                del self._replica[e]
+            self._stats["restore_republishes"] += 1
+        if totals:
+            self.publish_wave(totals)
 
     # ------------------------------------------------------------- read side
     def try_read(self, entity: str) -> Optional[Tuple[float, int]]:

@@ -33,6 +33,7 @@ from .typed import (Effect, EventSourcedBehavior,  # noqa: F401
                     PersistenceId, RetentionCriteria)
 from .query import (EventEnvelope, EventStream, NoOffset,  # noqa: F401
                     PersistenceQuery, ReadJournal, Sequence)
+from .entity_journal import EntityJournal, OP_ADD  # noqa: F401
 from .testkit import (FailIf, FailNextN, PassAll,  # noqa: F401
                       PersistenceTestKitJournal, ProcessingPolicy,
                       RejectNextN, journal_tck, snapshot_store_tck)
@@ -58,6 +59,7 @@ __all__ = [
     "EventSourcedBehavior", "Effect", "PersistenceId", "RetentionCriteria",
     "PersistenceQuery", "ReadJournal", "EventEnvelope", "EventStream",
     "Sequence", "NoOffset",
+    "EntityJournal", "OP_ADD",
     "PersistenceTestKitJournal", "ProcessingPolicy", "PassAll", "FailNextN",
     "RejectNextN", "FailIf", "journal_tck", "snapshot_store_tck",
     "slab_snapshot",

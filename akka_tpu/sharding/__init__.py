@@ -14,9 +14,12 @@ from .messages import (BeginHandOff, ClusterShardingStats,
                        StartEntityAck)
 from .coordinator import (LeastShardAllocationStrategy,
                           ShardAllocationStrategy, ShardCoordinator)
-from .region import (ClusterShardingSettings, InProcRememberEntitiesStore,
-                     RememberEntitiesStore, Shard, ShardRegion,
-                     default_extract_entity_id, make_default_extract_shard_id)
+from .region import (ClusterShardingSettings, DDataRememberEntitiesStore,
+                     InProcRememberEntitiesStore,
+                     JournalRememberEntitiesStore, RememberEntitiesStore,
+                     Shard, ShardRegion, default_extract_entity_id,
+                     make_default_extract_shard_id,
+                     make_remember_entities_store)
 from .sharding import ClusterSharding
 from .typed import (ClusterShardingTyped, Entity, EntityContext, EntityRef,
                     EntityTypeKey)
@@ -29,7 +32,9 @@ __all__ = [
     "ClusterSharding", "ClusterShardingSettings", "ShardRegion", "Shard",
     "ShardCoordinator", "ShardAllocationStrategy",
     "LeastShardAllocationStrategy", "RememberEntitiesStore",
-    "InProcRememberEntitiesStore", "default_extract_entity_id",
+    "InProcRememberEntitiesStore", "JournalRememberEntitiesStore",
+    "DDataRememberEntitiesStore", "make_remember_entities_store",
+    "default_extract_entity_id",
     "make_default_extract_shard_id", "GetShardRegionState",
     "CurrentShardRegionState", "GetClusterShardingStats",
     "ClusterShardingStats", "ShardState",

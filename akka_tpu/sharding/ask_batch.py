@@ -185,6 +185,7 @@ def execute_ask_batch(region, batch: Sequence[BatchAsk]) -> None:
         # the next run's single flush
         waiting = list(live)
         in_flight = {}  # row -> BatchAsk
+        ok_resolved: List[BatchAsk] = []  # replied members, wave order
 
         def stage_ready() -> None:
             nonlocal waiting
@@ -253,6 +254,7 @@ def execute_ask_batch(region, batch: Sequence[BatchAsk]) -> None:
             for row, a in in_flight.items():
                 if replied_blk is not None and bool(replied_blk[a.slot]):
                     a.outcome = np.asarray(reply_blk[a.slot])
+                    ok_resolved.append(a)
                     with region._lock:
                         region._promise_free.append(a.slot)
                     if a.trace is not None and tracer is not None:
@@ -278,6 +280,17 @@ def execute_ask_batch(region, batch: Sequence[BatchAsk]) -> None:
                 with wspan.child("wave.flush", wave_id=wave_id,
                                  deferred=True, n_staged=len(waiting)):
                     stage_ready()
+
+        # durable entity layer (ISSUE 15): ONE group-committed journal
+        # write for the whole wave's ok events, BEFORE outcomes reach the
+        # callers — an acked write is on disk by the time the ack exists.
+        # Regions without attach_entity_journal pay one attribute read.
+        if ok_resolved and \
+                getattr(region, "_entity_journal", None) is not None:
+            with wspan.child("wave.journal", wave_id=wave_id,
+                             n_events=len(ok_resolved)):
+                region._commit_entity_events(
+                    [(a.shard, a.index, a.message) for a in ok_resolved])
     finally:
         wspan.finish(rounds=rounds, steps=cum)
 

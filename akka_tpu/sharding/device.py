@@ -69,6 +69,11 @@ class DeviceEntity:
     # concurrently (SplitBrainResolver.scala:45-55 lease plumbing /
     # ShardCoordinator lease usage)
     lease: Optional[Any] = None
+    # optional durable remember-entities store (sharding/region.py SPI):
+    # first-touch allocations are add()ed, and restore() respawns every
+    # remembered id BEFORE replay — a restarted region re-hosts its
+    # entities with zero client traffic (Shard.scala remember-entities)
+    remember_store: Optional[Any] = None
 
 
 class DeviceEntityRef:
@@ -182,10 +187,22 @@ class DeviceShardRegion:
         self.checkpoint_dir: Optional[str] = None
         self._journal = None
         self._ents_fh = None
+        # durable entity layer (attach_entity_journal): per-entity event
+        # log group-committed at the ask-wave boundary; restore replays
+        # snapshot + event tail back into the durable state column
+        self._entity_journal = None
+        self._durable_col = "total"
+        self._per_event_fsync = False
+        self._durable_replayed_totals: Optional[Dict[str, float]] = None
 
         # entity registry: per-shard entity_id -> index (remember-entities)
         self._entities: List[Dict[str, int]] = [dict()
                                                 for _ in range(spec.n_shards)]
+        # reverse view (index -> entity_id) so the wave-boundary event
+        # emitter can name the entities a resolved ask touched without an
+        # O(entities) scan per wave
+        self._rev: List[Dict[int, str]] = [dict()
+                                           for _ in range(spec.n_shards)]
         self._spawned = np.zeros((spec.n_shards,), np.int32)
 
         self._sync_tables()
@@ -345,17 +362,23 @@ class DeviceShardRegion:
         """Resolve (allocating on first use — StartEntity semantics) the
         device entity for an id."""
         shard = self.shard_of(entity_id)
+        new = False
         with self._lock:
             idx = self._entities[shard].get(entity_id)
             if idx is None:
+                new = True
                 idx = len(self._entities[shard])
                 if idx >= self.eps:
                     raise RuntimeError(
                         f"shard {shard} full ({self.eps} entities)")
                 self._entities[shard][entity_id] = idx
+                self._rev[shard][idx] = entity_id
                 if getattr(self, "_ents_fh", None) is not None:
                     self._ents_fh.write(f"{shard}\t{idx}\t{entity_id}\n")
                     self._ents_fh.flush()
+        if new and self.spec.remember_store is not None:
+            self.spec.remember_store.add(self.type_name, str(shard),
+                                         entity_id)
         self._ensure_spawned(shard, idx)
         return DeviceEntityRef(self, shard, idx, entity_id)
 
@@ -531,6 +554,138 @@ class DeviceShardRegion:
         self._ents_fh = open(os.path.join(directory, "entities.log"), "a")
         return self._journal
 
+    def attach_entity_journal(self, directory: Optional[str] = None,
+                              fsync_every_n: int = 1,
+                              snapshot_every: int = 64,
+                              compact_every: int = 8192,
+                              state_col: str = "total",
+                              registry=None,
+                              per_event_fsync: bool = False):
+        """Arm the durable entity layer (ISSUE 15): every ok ask-wave's
+        events (entity_id, op, value, step) land as ONE group-committed
+        record in `entities.journal` BEFORE the wave's outcomes reach the
+        caller — an acked write is durable by the time the ack exists.
+        `fsync_every_n` counts WAVES (1 = one fsync per ask wave, the
+        machine-crash-safe serving default; appends always flush, so a
+        process kill -9 loses nothing at any n). restore()/failover()
+        then rebuild each entity's `state_col` from snapshot + event
+        tail — the acked frontier — after the slab+WAL replay.
+
+        `state_col` is the behavior's durable scalar column (the counter
+        family's "total"); the journaled op byte leaves room for richer
+        folds without a format change. `per_event_fsync=True` is the
+        bench A/B degenerate leg (one record + one fsync per EVENT —
+        what a per-entity synchronous write would cost), never the
+        serving configuration."""
+        from ..persistence.entity_journal import EntityJournal
+        directory = directory or self.checkpoint_dir
+        if directory is None:
+            raise RuntimeError(
+                "attach_entity_journal needs a directory (or "
+                "attach_journal first)")
+        os.makedirs(directory, exist_ok=True)
+        self._durable_col = state_col
+        self._per_event_fsync = per_event_fsync
+        self._entity_journal = EntityJournal(
+            os.path.join(directory, "entities.journal"),
+            flight_recorder=getattr(self.system, "flight_recorder", None),
+            fsync_every_n=fsync_every_n, snapshot_every=snapshot_every,
+            compact_every=compact_every, registry=registry)
+        return self._entity_journal
+
+    def detach_entity_journal(self) -> None:
+        """Disarm (bench A/B legs): closes the journal and stops the
+        wave-boundary emission; state already journaled stays on disk."""
+        ej, self._entity_journal = self._entity_journal, None
+        self._per_event_fsync = False
+        if ej is not None:
+            ej.close()
+
+    def _commit_entity_events(self, resolved) -> None:
+        """Wave-boundary group commit (called by execute_ask_batch with
+        the wave's ok members while the caller still holds `_ask_lock`):
+        name each resolved (shard, index) via the reverse registry, drop
+        no-op events (a gateway get is add(0) — no durable effect), and
+        append everything as one record. The fsync (per fsync_every_n
+        waves) happens HERE, before any ack leaves — zero lost acked
+        writes across a machine crash, not just a process kill."""
+        ej = self._entity_journal
+        if ej is None:
+            return
+        from ..persistence.entity_journal import OP_ADD
+        events = []
+        with self._lock:
+            for shard, index, message in resolved:
+                body = np.asarray(message, np.float64).reshape(-1)
+                value = float(body[0]) if body.size else 0.0
+                if value == 0.0:
+                    continue
+                eid = self._rev[shard].get(index)
+                if eid is not None:
+                    events.append((eid, OP_ADD, value))
+        if events:
+            ej.append_wave(int(self.system._host_step), events,
+                           per_event_fsync=self._per_event_fsync)
+
+    def _respawn_remembered(self) -> None:
+        """Re-host every remembered entity with zero client traffic:
+        union the durable remember-entities store (spec.remember_store)
+        and the entity journal's fold into the registry, allocating rows
+        for ids the sidecar/entities.log missed (e.g. a store shared by a
+        prior incarnation on another node). Runs BEFORE replay so the
+        replayed totals always find their rows alive."""
+        ids = set()
+        store = self.spec.remember_store
+        if store is not None:
+            for shard in range(self.spec.n_shards):
+                ids.update(store.remembered(self.type_name, str(shard)))
+        if self._entity_journal is not None:
+            ids.update(self._entity_journal.totals())
+        for eid in sorted(ids):
+            self.entity_ref(eid)
+
+    def _replay_entities(self) -> Dict[str, float]:
+        """Reconstruct per-entity durable state from the entity journal
+        (snapshot + event tail = the acked frontier) and write it into
+        the durable state column in ONE pow2-floor-64-padded scatter.
+        Runs AFTER the slab+WAL replay flush: the WAL may have re-applied
+        writes that were never acked (in-flight at the crash, timed-out
+        asks) — overwriting with the journal fold pins restored state to
+        exactly what clients were acknowledged, keeping
+        acked_sum <= final_total <= sent_sum tight on the left."""
+        ej = self._entity_journal
+        if ej is None:
+            return {}
+        totals = ej.totals()
+        self._durable_replayed_totals = totals
+        if not totals:
+            return totals
+        rows, vals = [], []
+        for eid, total in totals.items():
+            ref = self.entity_ref(eid)
+            rows.append(ref.row)
+            vals.append(total)
+        sys = self.system
+        n = len(rows)
+        pad = max(64, 1 << (n - 1).bit_length()) - n
+        rows_np = np.asarray(rows, np.int32)
+        vals_np = np.asarray(vals, np.float32)
+        if pad:  # duplicate leading index, identical value: idempotent
+            rows_np = np.concatenate([rows_np,
+                                      np.full(pad, rows_np[0], np.int32)])
+            vals_np = np.concatenate([vals_np,
+                                      np.full(pad, vals_np[0], np.float32)])
+        idx = jnp.asarray(rows_np)
+        col = sys.state[self._durable_col]
+        sys.state[self._durable_col] = col.at[idx].set(
+            jnp.asarray(vals_np, col.dtype))
+        fr = getattr(sys, "flight_recorder", None)
+        if fr is not None and getattr(fr, "enabled", False):
+            fr.event("entity_replayed", entities=len(totals),
+                     events=int(sum(ej.replayed_events().values())),
+                     step=int(sys._host_step))
+        return totals
+
     def _sidecar_path(self) -> str:
         return os.path.join(self.checkpoint_dir, "region.json")
 
@@ -563,6 +718,10 @@ class DeviceShardRegion:
         with self._ask_lock:
             path = self.system.checkpoint(self.checkpoint_dir, keep=keep)
             self._write_sidecar()
+            if self._entity_journal is not None:
+                # every event so far is covered by the live fold: rewrite
+                # the log as one snap-all record (bounded replay tail)
+                self._entity_journal.compact()
         # allocations up to here are covered by the sidecar: reset the log
         if self._ents_fh is not None:
             self._ents_fh.close()
@@ -588,8 +747,17 @@ class DeviceShardRegion:
                 doc = json.load(f)
             self._load_sidecar(doc)
             self._merge_entity_log()
+            # durable remember-entities: allocate rows for ids known only
+            # to the store / entity journal BEFORE replay, so replayed
+            # state always finds its rows alive (and a restarted region
+            # re-hosts every remembered entity with zero client traffic)
+            self._respawn_remembered()
             self._sync_tables()  # tables feed the replayed steps
-            return self._restore_and_replay(path)
+            step = self._restore_and_replay(path)
+            # entity-journal replay LAST: pin durable columns to the
+            # acked frontier on top of the slab+WAL reconstruction
+            self._replay_entities()
+            return step
 
     def _merge_entity_log(self) -> None:
         """Fold entities.log into the registry: allocations since the last
@@ -607,6 +775,8 @@ class DeviceShardRegion:
                 shard, idx = int(parts[0]), int(parts[1])
                 with self._lock:
                     self._entities[shard].setdefault(parts[2], idx)
+                    self._rev[shard][self._entities[shard][parts[2]]] = \
+                        parts[2]
                     self._spawned[shard] = max(int(self._spawned[shard]),
                                                idx + 1)
 
@@ -655,6 +825,8 @@ class DeviceShardRegion:
             self._promise_retired = [int(s) for s in doc["promise_retired"]]
             self._entities = [{str(k): int(v) for k, v in d.items()}
                               for d in doc["entities"]]
+            self._rev = [{v: k for k, v in d.items()}
+                         for d in self._entities]
             self._spawned = np.asarray(doc["spawned"], np.int32)
 
     def failover(self, survivors: Sequence[Any]) -> int:
@@ -705,6 +877,10 @@ class DeviceShardRegion:
         self._sync_tables()  # before replay: behaviors read shard_row_base
         step = self._restore_and_replay(path)
         new.tell_journal = old_journal  # re-arm AFTER replay (no re-journal)
+        # durable entity layer: the in-process journal's fold is current,
+        # so the survivor mesh gets the same acked-frontier overwrite a
+        # fresh-process restore gets (in-flight unacked asks just failed)
+        self._replay_entities()
         return step
 
     # ------------------------------------------------------------------ run

@@ -38,6 +38,12 @@ class ClusterShardingSettings:
     rebalance_interval: float = 1.0
     passivate_idle_after: Optional[float] = None  # seconds; None = off
     remember_entities: bool = False
+    # which RememberEntitiesStore backs remember_entities (reference:
+    # akka.cluster.sharding.remember-entities-store): "inproc" (tests),
+    # "journal" (file-backed record log, needs remember_entities_dir), or
+    # "ddata" (ORSet of ids per shard riding the op-delta replicator)
+    remember_entities_store: str = "inproc"
+    remember_entities_dir: Optional[str] = None
     role: Optional[str] = None
 
 
@@ -104,6 +110,205 @@ class InProcRememberEntitiesStore(RememberEntitiesStore):
     def reset(cls):
         with cls._lock:
             cls._data.clear()
+
+
+class JournalRememberEntitiesStore(RememberEntitiesStore):
+    """Durable file-backed store: add/remove ops append to a
+    length-prefixed record log (the FileJournal/TellJournal format, torn
+    tails truncated on open), folded into memory at open so remembered()
+    never touches the disk. A restarted region reads back exactly the
+    ids whose add() was flushed — the eventsourced remember-entities
+    provider (reference: EventSourcedRememberEntitiesShardStore.scala)
+    at record-log simplicity.
+
+    Appends are idempotence-elided (re-adding a present id writes
+    nothing), flushed per record (kill -9 safe) and fsync'd every
+    `fsync_every_n` appends; `compact()` rewrites the log as one
+    snapshot record per non-empty (type, shard)."""
+
+    def __init__(self, path: str, flight_recorder: Any = None,
+                 fsync_every_n: int = 1):
+        import os
+        from ..persistence.journal import (repair_record_log,
+                                           scan_record_log)
+        self.path = path
+        self.fsync_every_n = max(1, int(fsync_every_n))
+        self._since_fsync = 0
+        self._lock = threading.Lock()
+        self._data: Dict[Tuple[str, str], Set[str]] = {}
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self.truncated_bytes = repair_record_log(path, flight_recorder)
+        for _end, rec in scan_record_log(path):
+            self._apply(rec)
+        self._fh = open(path, "ab")
+
+    def _apply(self, rec: Dict[str, Any]) -> None:
+        op = rec.get("op")
+        if op == "snap":
+            for type_name, shard_id, ids in rec.get("data", ()):
+                self._data[(type_name, shard_id)] = set(ids)
+            return
+        key = (rec["type"], rec["shard"])
+        if op == "add":
+            self._data.setdefault(key, set()).add(rec["eid"])
+        elif op == "remove":
+            self._data.get(key, set()).discard(rec["eid"])
+
+    def _append_locked(self, rec: Dict[str, Any]) -> None:
+        import os
+        import pickle
+        if self._fh is None:
+            raise ValueError("JournalRememberEntitiesStore is closed")
+        blob = pickle.dumps(rec, protocol=4)
+        self._fh.write(len(blob).to_bytes(8, "little"))
+        self._fh.write(blob)
+        self._fh.flush()
+        self._since_fsync += 1
+        if self._since_fsync >= self.fsync_every_n:
+            os.fsync(self._fh.fileno())
+            self._since_fsync = 0
+
+    def remembered(self, type_name, shard_id):
+        with self._lock:
+            return set(self._data.get((type_name, shard_id), set()))
+
+    def add(self, type_name, shard_id, entity_id):
+        with self._lock:
+            ids = self._data.setdefault((type_name, shard_id), set())
+            if entity_id in ids:
+                return
+            ids.add(entity_id)
+            self._append_locked({"op": "add", "type": type_name,
+                                 "shard": shard_id, "eid": entity_id})
+
+    def remove(self, type_name, shard_id, entity_id):
+        with self._lock:
+            ids = self._data.get((type_name, shard_id), set())
+            if entity_id not in ids:
+                return
+            ids.discard(entity_id)
+            self._append_locked({"op": "remove", "type": type_name,
+                                 "shard": shard_id, "eid": entity_id})
+
+    def compact(self) -> int:
+        """Atomic log rewrite: one snapshot record covering the live
+        fold. Returns the number of remembered ids retained."""
+        import os
+        import pickle
+        with self._lock:
+            if self._fh is None:
+                raise ValueError("JournalRememberEntitiesStore is closed")
+            data = [(t, s, sorted(ids))
+                    for (t, s), ids in self._data.items() if ids]
+            blob = pickle.dumps({"op": "snap", "data": data}, protocol=4)
+            tmp = self.path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(len(blob).to_bytes(8, "little"))
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            self._fh.close()
+            os.replace(tmp, self.path)
+            self._fh = open(self.path, "ab")
+            self._since_fsync = 0
+            return sum(len(ids) for _t, _s, ids in data)
+
+    def close(self) -> None:
+        import os
+        with self._lock:
+            if self._fh is not None:
+                if self._since_fsync:
+                    self._fh.flush()
+                    os.fsync(self._fh.fileno())
+                    self._since_fsync = 0
+                self._fh.close()
+                self._fh = None
+
+
+class DDataRememberEntitiesStore(RememberEntitiesStore):
+    """Replicated store: one ORSet of entity ids per (type, shard) key in
+    the ddata Replicator — adds/removes travel as the op-based deltas of
+    PR 14 (an add to a 10k-id set gossips O(1 id), not the set), and a
+    region restarted on ANY node of the cluster reads back the ids with
+    one local Get (reference: DDataRememberEntitiesShardStore.scala).
+
+    Local-first semantics: updates are WriteLocal (the shard's add must
+    never block on a quorum — the reference uses majority writes but
+    batches behind the shard's message stash; here gossip + delta ticks
+    converge the set) and reads are ReadLocal."""
+
+    def __init__(self, system, key_prefix: str = "sharding-remember",
+                 timeout: float = 5.0):
+        from ..ddata import DistributedData
+        dd = DistributedData.get(system)
+        self.system = system
+        self.replicator = dd.replicator
+        self.node = dd.self_unique_address
+        self.key_prefix = key_prefix
+        self.timeout = float(timeout)
+
+    def _key(self, type_name: str, shard_id: str):
+        from ..ddata import Key
+        return Key(f"{self.key_prefix}-{type_name}-{shard_id}")
+
+    def remembered(self, type_name, shard_id):
+        from ..ddata import Get, GetSuccess, ReadLocal
+        from ..pattern.ask import ask_sync
+        rep = ask_sync(self.replicator,
+                       Get(self._key(type_name, shard_id), ReadLocal()),
+                       timeout=self.timeout, system=self.system)
+        if isinstance(rep, GetSuccess):
+            return set(rep.data.elements)
+        return set()  # NotFound: nothing remembered yet
+
+    def _update(self, type_name, shard_id, modify) -> None:
+        from ..ddata import ORSet, Update, UpdateSuccess, WriteLocal
+        from ..pattern.ask import ask_sync
+        rep = ask_sync(self.replicator,
+                       Update(self._key(type_name, shard_id),
+                              ORSet.empty(), WriteLocal(), modify=modify),
+                       timeout=self.timeout, system=self.system)
+        if not isinstance(rep, UpdateSuccess):
+            raise RuntimeError(
+                f"remember-entities ddata update failed: {rep!r}")
+
+    def add(self, type_name, shard_id, entity_id):
+        self._update(type_name, shard_id,
+                     lambda s: s.add(self.node, entity_id))
+
+    def remove(self, type_name, shard_id, entity_id):
+        self._update(type_name, shard_id,
+                     lambda s: s.remove(self.node, entity_id))
+
+
+def make_remember_entities_store(
+        settings: "ClusterShardingSettings", system=None,
+        flight_recorder: Any = None) -> Optional[RememberEntitiesStore]:
+    """Resolve `settings.remember_entities_store` to an impl (None when
+    remember_entities is off). "journal" needs remember_entities_dir;
+    "ddata" needs the ActorSystem hosting the replicator."""
+    if not settings.remember_entities:
+        return None
+    kind = settings.remember_entities_store or "inproc"
+    if kind == "inproc":
+        return InProcRememberEntitiesStore()
+    if kind == "journal":
+        import os
+        if not settings.remember_entities_dir:
+            raise ValueError(
+                "remember_entities_store='journal' needs "
+                "remember_entities_dir")
+        return JournalRememberEntitiesStore(
+            os.path.join(settings.remember_entities_dir,
+                         "remember_entities.journal"),
+            flight_recorder=flight_recorder)
+    if kind == "ddata":
+        if system is None:
+            raise ValueError(
+                "remember_entities_store='ddata' needs the ActorSystem")
+        return DDataRememberEntitiesStore(system)
+    raise ValueError(f"unknown remember_entities_store {kind!r}")
 
 
 @dataclass(frozen=True)
@@ -296,8 +501,12 @@ class ShardRegion(Actor):
             make_default_extract_shard_id(settings.number_of_shards)
         self.settings = settings
         self.manager_path = coordinator_manager_path
-        self.store = store or (InProcRememberEntitiesStore()
-                               if settings.remember_entities else None)
+        # "ddata" needs the replicator's ActorSystem, which only exists
+        # once the actor starts — defer that kind to pre_start
+        self.store = store if store is not None else (
+            make_remember_entities_store(settings)
+            if settings.remember_entities and
+            settings.remember_entities_store != "ddata" else None)
         self.coordinator = None               # direct ref once registered
         self.shard_homes: Dict[str, str] = {}  # shard -> region path
         self.shards: Dict[str, Any] = {}       # local shard id -> shard ref
@@ -330,6 +539,9 @@ class ShardRegion(Actor):
                          f"{self.manager_path}/coordinator")
 
     def pre_start(self) -> None:
+        if self.store is None and self.settings.remember_entities:
+            self.store = make_remember_entities_store(
+                self.settings, system=self.context.system)
         self._task = self.context.system.scheduler.schedule_tell_with_fixed_delay(
             0.05, self.settings.retry_interval, self.self_ref, _RetryTick())
 

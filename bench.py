@@ -1518,6 +1518,118 @@ def bench_gateway_replica_ab(region, per_leg: int = 384):
                    and rep["staleness_bound_held"] == 1)}
 
 
+def bench_gateway_durable_ab(region, per_leg: int = 384):
+    """Durable-entity write-path A/B (ISSUE 15 acceptance): 64 clients,
+    an all-add mix over 48 entities through handle_frame, equal
+    admission (wide open) on one shared warm region, three legs:
+
+    - off:        entity journal detached — the non-durable baseline.
+    - wave_commit: attach_entity_journal(fsync_every_n=1) — ONE
+      group-committed record + ONE fsync per ask wave, the serving
+      default. The journal stats are the group-commit proof:
+      waves << events and fsyncs == waves.
+    - per_event:  the degenerate comparison — one record + one fsync
+      per EVENT, what a per-entity synchronous write would cost.
+
+    Acceptance: wave-commit durable throughput >= 0.5x non-durable at
+    equal admission, and every leg's acked adds are conserved in the
+    journal fold (journal events_sum == the leg's admitted value sum)."""
+    import tempfile as _tempfile
+    import threading as _threading
+
+    from akka_tpu.event.metrics import MetricsRegistry
+    from akka_tpu.gateway import (AdmissionController, GatewayServer,
+                                  RegionBackend, SloTracker)
+
+    clients = 64
+    per_client = max(10, per_leg // clients)
+
+    def leg(mode: str):
+        backend = RegionBackend(region, max_batch=64)
+        slo = SloTracker(target_p50_ms=50.0, target_p99_ms=250.0)
+        adm = AdmissionController(rate=1e9, burst=1e9)
+        srv = GatewayServer(None, backend, adm, slo)
+        reg = MetricsRegistry()
+        tmp = None
+        if mode != "off":
+            tmp = _tempfile.mkdtemp(prefix=f"bench_durable_{mode}_")
+            region.attach_entity_journal(
+                tmp, fsync_every_n=1, registry=reg,
+                per_event_fsync=(mode == "per_event"))
+        not_ok = []
+
+        def worker(w: int):
+            for i in range(per_client):
+                rep = json.loads(srv.handle_frame(json.dumps(
+                    {"id": w * per_client + i, "tenant": f"t{w % 4}",
+                     "entity": f"dur-{(w * 7 + i) % 48}", "op": "add",
+                     "value": float(i % 5 + 1)}).encode()))
+                if rep["status"] != "ok":
+                    not_ok.append(rep["status"])
+
+        threads = [_threading.Thread(target=worker, args=(w,))
+                   for w in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        n = per_client * clients
+        art = slo.artifact()
+        backend.close()
+        row = {"leg": mode, "clients": clients, "requests": n,
+               "wall_s": round(dt, 3), "req_per_sec": round(n / dt, 1),
+               "not_ok": len(not_ok), "admitted": adm.admitted,
+               "rejected": adm.rejected,
+               "p50_ms": art["p50_ms"], "p99_ms": art["p99_ms"]}
+        if mode != "off":
+            ej = region._entity_journal
+            st = ej.stats()
+            batch = reg.histogram("entity_journal_batch_size").snapshot()
+            fsync = reg.histogram("entity_journal_fsync_ms").snapshot()
+            row.update(
+                journal_waves=st["waves"], journal_events=st["events"],
+                journal_fsyncs=st["fsyncs"],
+                journal_bytes=st["bytes"],
+                events_per_commit=round(
+                    st["events"] / max(st["waves"], 1), 2),
+                fsync_p99_ms=fsync["p99"],
+                # conservation: the journal fold must hold exactly the
+                # acked adds of this leg — the durability claim itself
+                journal_sum=round(sum(ej.totals().values()), 1),
+                batch_count=batch.get("count", 0))
+            region.detach_entity_journal()
+        try:
+            row["host_loadavg"] = round(os.getloadavg()[0], 2)
+        except OSError:
+            pass
+        return row
+
+    off = leg("off")
+    wave = leg("wave_commit")
+    per_event = leg("per_event")
+    ratio = round(wave["req_per_sec"] / max(off["req_per_sec"], 1e-9), 3)
+    acked_value_sum = float(sum(
+        (i % 5 + 1) for _w in range(clients) for i in range(per_client)))
+    return {"off": off, "wave_commit": wave, "per_event": per_event,
+            "durable_vs_off_ratio": ratio,
+            "per_event_vs_wave": round(
+                per_event["req_per_sec"]
+                / max(wave["req_per_sec"], 1e-9), 3),
+            "equal_admission": (off["admitted"] == wave["admitted"]
+                                == per_event["admitted"]
+                                and off["rejected"] == wave["rejected"]
+                                == per_event["rejected"] == 0),
+            "group_commit_proof": (
+                wave["journal_fsyncs"] == wave["journal_waves"]
+                and wave["journal_events"] > wave["journal_waves"]),
+            "ok": (ratio >= 0.5 and wave["not_ok"] == 0
+                   and wave["journal_sum"] == round(acked_value_sum, 1)
+                   and per_event["journal_sum"] == round(
+                       acked_value_sum, 1))}
+
+
 def bench_tracing_overhead(region, per_leg: int = 384):
     """tracing-overhead (ISSUE 12): the gateway 64-client batched leg
     (same mix as bench_gateway_concurrency) run three ways on one shared
@@ -1799,13 +1911,15 @@ def bench_gateway_slo(n_requests: int = 400, n_entities: int = 16):
     binary_ab = bench_gateway_binary_ab(region, per_leg=n_requests)
     ingest_ab = bench_gateway_ingest_ab(region, per_leg=n_requests)
     replica_ab = bench_gateway_replica_ab(region, per_leg=n_requests)
+    durable_ab = bench_gateway_durable_ab(region, per_leg=n_requests)
     return {"below_threshold": below, "overload": over,
             "entities_total": round(total, 1),
             "shed_working": over["rejects"] > 0 and below["rejects"] == 0,
             "concurrency": concurrency,
             "binary_ab": binary_ab,
             "ingest_ab": ingest_ab,
-            "replica_ab": replica_ab}
+            "replica_ab": replica_ab,
+            "durable_ab": durable_ab}
 
 
 def main() -> None:
@@ -2125,6 +2239,7 @@ def main() -> None:
                 ab = out["binary_ab"]
                 ia = out["ingest_ab"]
                 ra = out["replica_ab"]
+                da = out["durable_ab"]
                 print(f"[bench] gateway-slo: p50={b['p50_ms']}ms "
                       f"p99={b['p99_ms']}ms @{b['req_per_sec']}req/s | "
                       f"overload reject_rate={o['reject_rate']} "
@@ -2135,7 +2250,11 @@ def main() -> None:
                       f"win={ia['mean_window_size']} "
                       f"{'OK' if ia['ok'] else 'FAIL'} | "
                       f"replica p99 ratio={ra['replica_p99_ratio']} "
-                      f"{'OK' if ra['ok'] else 'FAIL'}",
+                      f"{'OK' if ra['ok'] else 'FAIL'} | "
+                      f"durable x{da['durable_vs_off_ratio']} "
+                      f"evts/commit="
+                      f"{da['wave_commit']['events_per_commit']} "
+                      f"{'OK' if da['ok'] else 'FAIL'}",
                       file=sys.stderr)
                 print(json.dumps({
                     "metric": "gateway serving latency p99, sustained load "

@@ -58,11 +58,26 @@ def cmd_serve(args: argparse.Namespace) -> int:
                         entities_per_shard=args.eps,
                         n_devices=args.devices,
                         payload_width=4)
+    if args.durable:
+        # durable entity layer (docs/DURABLE_ENTITIES.md): remembered ids
+        # in a record-log store, per-entity events group-committed at the
+        # ask-wave boundary into the entity journal
+        from akka_tpu.sharding import JournalRememberEntitiesStore
+        spec.remember_store = JournalRememberEntitiesStore(
+            os.path.join(args.dir, "remember_entities.journal"))
     region = DeviceShardRegion(spec)
     region.attach_journal(args.dir, fsync_every_n=args.fsync_every_n)
+    if args.durable:
+        region.attach_entity_journal(
+            args.dir, fsync_every_n=args.fsync_every_n,
+            registry=system.metrics_registry)
     if args.restore:
         step = region.restore()
         print(f"RESTORED step={step}", flush=True)
+        if args.durable:
+            replayed = region._durable_replayed_totals or {}
+            print(f"DURABLE respawned={len(replayed)} "
+                  f"sum={sum(replayed.values()):.1f}", flush=True)
     else:
         region.checkpoint()  # baseline snapshot so crash recovery can start
     backend = RegionBackend(region)
@@ -151,7 +166,7 @@ def cmd_load(args: argparse.Namespace) -> int:
 
 # ------------------------------------------------------------------- demo
 def _spawn_serve(port: int, directory: str, restore: bool = False,
-                 devices: int = 2) -> subprocess.Popen:
+                 devices: int = 2, durable: bool = False) -> subprocess.Popen:
     env = dict(os.environ)
     if env.get("JAX_PLATFORMS", "").startswith("cpu") or \
             "JAX_PLATFORMS" not in env:
@@ -166,6 +181,8 @@ def _spawn_serve(port: int, directory: str, restore: bool = False,
            "--rate", "400", "--burst", "200"]
     if restore:
         cmd.append("--restore")
+    if durable:
+        cmd.append("--durable")
     return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True)
 
@@ -278,6 +295,8 @@ def main(argv=None) -> int:
     s.add_argument("--rate", type=float, default=200.0)
     s.add_argument("--burst", type=float, default=100.0)
     s.add_argument("--fsync-every-n", type=int, default=1)
+    s.add_argument("--durable", action="store_true",
+                   help="entity journal + durable remember-entities")
     s.add_argument("--target-p50-ms", type=float, default=50.0)
     s.add_argument("--target-p99-ms", type=float, default=500.0)
 

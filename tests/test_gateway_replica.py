@@ -97,6 +97,35 @@ def test_replica_window_expiry_resets_promotion_count():
     assert c.stats()["promotions"] == 1
 
 
+def test_republish_restored_overwrites_stale_and_drops_unacked():
+    """Staleness ACROSS restart (ISSUE 15 satellite): the restored
+    `_host_step` lands near the crash frontier, so pre-crash replica
+    entries read as fresh (small positive lag) even though the device
+    was truncated to the acked frontier. `republish_restored` re-stamps
+    every journal-covered entity at the NEW step with its acked total
+    and drops the rest — a pre-restore value can never be served."""
+    clk = StepClock(100)
+    c = ReadReplicaCache(clk, hot_hits=1, max_step_lag=64)
+    c.publish_wave({"a": 5.0, "b": 3.0})  # pre-crash view at step 100
+    clk.advance(4)  # restore lands just past the crash frontier
+    # without the fix, both entries would serve at lag 4 <= 64
+    c.republish_restored({"a": 4.0})  # the journal's acked frontier
+    assert c.try_read("a") == (4.0, 0)  # restored value, fresh stamp
+    assert c.try_read("b") is None  # dropped: pre-crash unacked state
+    st = c.stats()
+    assert st["fallthrough_cold"] == 1
+    assert st["restore_republishes"] == 1
+
+
+def test_republish_restored_empty_journal_drops_everything():
+    clk = StepClock(10)
+    c = ReadReplicaCache(clk, hot_hits=1)
+    c.publish_wave({"x": 1.0, "y": 2.0})
+    c.republish_restored(None)  # nothing acked before the crash
+    assert c.try_read("x") is None and c.try_read("y") is None
+    assert c.stats()["replica_entries"] == 0
+
+
 # ------------------------------------------------------ gateway integration
 @pytest.fixture(scope="module")
 def small_region():

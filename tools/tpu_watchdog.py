@@ -490,6 +490,34 @@ def on_tpu_found(detail: str) -> None:
                             "staleness_bound_held":
                                 rl.get("staleness_bound_held"),
                             "replica_speedup": ra.get("speedup")})
+            da = gw.get("durable_ab", {})
+            if da:
+                # durable entities (ISSUE 15): entity journal armed vs
+                # off at equal admission, all-add mix at 64 clients;
+                # acceptance is durable (wave-commit) req/s >= 0.5x
+                # non-durable AND the journal fold conserved the acked
+                # adds exactly (the bench's `ok` asserts both), with
+                # the group-commit proof (one fsync per wave, many
+                # events per record) carried alongside
+                wl = da.get("wave_commit", {})
+                append_log({"ts": _utcnow(),
+                            "ok": bool(da.get("ok")) and
+                                  bool(da.get("equal_admission")),
+                            "detail": "durable entities "
+                                      "(journal on/off, equal admission)",
+                            "durable_vs_off_ratio":
+                                da.get("durable_vs_off_ratio"),
+                            "durable_req_per_sec":
+                                wl.get("req_per_sec"),
+                            "off_req_per_sec":
+                                da.get("off", {}).get("req_per_sec"),
+                            "events_per_commit":
+                                wl.get("events_per_commit"),
+                            "fsync_p99_ms": wl.get("fsync_p99_ms"),
+                            "group_commit_proof":
+                                da.get("group_commit_proof"),
+                            "per_event_vs_wave":
+                                da.get("per_event_vs_wave")})
     # wire-decode throughput: batch np.frombuffer vs json.loads, plus the
     # full-path 1/8/64-client encoding sweep (docs/SERVING_GATEWAY.md
     # wire-protocol section)
