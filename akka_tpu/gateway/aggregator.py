@@ -64,6 +64,17 @@ class IngestAggregator:
         self.window_s = float(window_s)
         self._lock = threading.Lock()
         self._work = threading.Event()
+        # continuous wave formation (ISSUE 16): when the server's
+        # backend runs the continuous scheduler, windows are submitted
+        # asynchronously (`submit_frames`) — the dispatcher stages
+        # window N and immediately starts decoding/admitting window N+1
+        # while N's device rounds are in flight. The semaphore bounds
+        # dispatcher windows in flight to the server's pipeline depth.
+        self._continuous = bool(getattr(server, "continuous", False)) \
+            and hasattr(server, "submit_frames")
+        self._inflight = 0
+        self._depth_sem = threading.BoundedSemaphore(
+            max(1, int(getattr(server, "pipeline_depth", 4))))
         self._pending: List[_PendingFrame] = []
         self._pending_records = 0
         self._thread: Optional[threading.Thread] = None
@@ -130,6 +141,21 @@ class IngestAggregator:
         with self._lock:
             return self._pending_records >= self.max_window
 
+    def _idle(self) -> bool:
+        """Window fast-close predicate (ISSUE 16 satellite): exactly ONE
+        frame is pending, no window of ours is in flight, and the
+        backend's ask pipeline is idle — a lone frame under light load
+        closes its window immediately instead of eating the full
+        adaptive deadline. Two or more pending frames ARE concurrency
+        (and downstream idleness flickers true between waves), so the
+        adaptive wait behaves exactly as before under load."""
+        with self._lock:
+            if self._inflight or len(self._pending) > 1:
+                return False
+        batcher = getattr(getattr(self.server, "backend", None),
+                          "batcher", None)
+        return batcher is None or batcher.idle()
+
     def _loop(self) -> None:
         while True:
             self._work.wait(0.25)
@@ -141,9 +167,10 @@ class IngestAggregator:
                     closing = self._closed
                 if not closing:
                     # the AskBatcher's adaptive close: re-check fullness
-                    # on every submit wakeup until the deadline
+                    # on every submit wakeup until the deadline, closing
+                    # immediately when the whole pipeline is idle
                     wait_adaptive_close(self._work, self.window_s,
-                                        self._full)
+                                        self._full, idle=self._idle)
                 with self._lock:
                     window: List[_PendingFrame] = []
                     taken = 0
@@ -166,6 +193,9 @@ class IngestAggregator:
     def _run_window(self, window: List[_PendingFrame],
                     n_records: int) -> None:
         t_close = time.perf_counter()
+        if self._continuous:
+            self._run_window_async(window, n_records, t_close)
+            return
         try:
             replies = self.server._serve_frames([f.body for f in window])
         except BaseException as e:  # noqa: BLE001 — fail the window's
@@ -173,6 +203,57 @@ class IngestAggregator:
                 if not f.future.done():
                     f.future.set_exception(e)
             return
+        self._account(window, n_records, t_close)
+        for f, body in zip(window, replies):
+            f.future.set_result(body)
+
+    def _run_window_async(self, window: List[_PendingFrame],
+                          n_records: int, t_close: float) -> None:
+        """Continuous path (ISSUE 16 tentpole): stage the window's wave
+        via `submit_frames` (on THIS dispatcher thread — submit order is
+        the staging order, so per-connection FIFO stays structural) and
+        return to window formation immediately; frame futures complete
+        at the wave's resolve boundary. The depth semaphore blocks
+        window N+depth's staging until an older wave resolves, bounding
+        promise-pool pressure."""
+        self._depth_sem.acquire()
+        with self._lock:
+            self._inflight += 1
+
+        def _settle_err(e: BaseException) -> None:
+            for f in window:
+                if not f.future.done():
+                    f.future.set_exception(e)
+
+        try:
+            sfut = self.server.submit_frames([f.body for f in window])
+        except BaseException as e:  # noqa: BLE001 — never strand futures
+            with self._lock:
+                self._inflight -= 1
+            self._depth_sem.release()
+            _settle_err(e)
+            return
+
+        def _finish(sf) -> None:
+            try:
+                try:
+                    replies = sf.result()
+                except BaseException as e:  # noqa: BLE001
+                    _settle_err(e)
+                    return
+                self._account(window, n_records, t_close)
+                for f, body in zip(window, replies):
+                    f.future.set_result(body)
+            finally:
+                with self._lock:
+                    self._inflight -= 1
+                self._depth_sem.release()
+                self._work.set()  # idle may have transitioned: fast-close
+
+        sfut.add_done_callback(_finish)
+
+    def _account(self, window: List[_PendingFrame], n_records: int,
+                 t_close: float) -> None:
         with self._lock:
             self._windows += 1
             self._frames += len(window)
@@ -185,8 +266,6 @@ class IngestAggregator:
             self._h_size.observe(float(n_records), step=step)
             self._h_wait.observe_many(
                 [(t_close - f.t_submit) * 1e6 for f in window], step=step)
-        for f, body in zip(window, replies):
-            f.future.set_result(body)
 
     # ------------------------------------------------------------ shutdown
     def close(self, timeout: float = 10.0) -> None:
@@ -200,6 +279,16 @@ class IngestAggregator:
         self._work.set()
         if t is not None:
             t.join(timeout)
+        # continuous windows still in flight resolve on the scheduler
+        # thread — wait for them so close() stays a drain, not a drop
+        deadline = time.perf_counter() + timeout
+        while True:
+            with self._lock:
+                if not self._inflight:
+                    break
+            if time.perf_counter() >= deadline:
+                break
+            time.sleep(1e-3)
         # dispatcher never ran (or died): nothing may stay unresolved
         with self._lock:
             leftover, self._pending = self._pending, []

@@ -69,7 +69,9 @@ import itertools
 import json
 import socket
 import struct
+import threading
 import time
+from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -168,17 +170,23 @@ class RegionBackend:
 
     def __init__(self, region, steps: int = 2, max_extra_steps: int = 16,
                  batch: bool = True, max_batch: int = 32,
-                 batch_window_s: float = 200e-6, registry=None):
+                 batch_window_s: float = 200e-6, registry=None,
+                 continuous: bool = False, pipeline_depth: int = 4):
         self.region = region
         self.steps = steps
         self.max_extra_steps = max_extra_steps
+        # continuous wave formation (ISSUE 16): waves overlap on the
+        # bridge via the ContinuousWaveScheduler instead of serializing
+        # under _ask_lock; False keeps the PR 15 serve path byte-for-byte
+        self.continuous = bool(continuous) and batch
         self.batcher = None
         if batch:
             from ..sharding.ask_batch import AskBatcher
             self.batcher = AskBatcher(
                 region, max_batch=max_batch, window_s=batch_window_s,
                 steps=steps, max_extra_steps=max_extra_steps,
-                registry=registry)
+                registry=registry, continuous=continuous,
+                pipeline_depth=pipeline_depth)
 
     def ask(self, entity_id: str, value: float) -> float:
         ref = self.region.entity_ref(entity_id)
@@ -190,20 +198,13 @@ class RegionBackend:
                                     max_extra_steps=self.max_extra_steps)
         return float(np.asarray(reply)[0])
 
-    def ask_many(self, entity_ids: Sequence[str],
-                 values: Sequence[float],
-                 ctxs: Optional[Sequence[Any]] = None) -> List[Any]:
-        """Columnar wave ask for a decoded binary window: entity ids are
-        resolved ONCE per unique id, the whole wave rides
-        `AskBatcher.ask_many` (one coalesced flush + one shared step
-        budget, no per-call future hop) and the return is outcome-
-        aligned — a float total or the per-ask exception INSTANCE
-        (AskPoolExhausted / TimeoutError / ...), never a raise, so one
-        member's failure cannot fail its wave-mates.
-
-        `ctxs` (ISSUE 12): optional aligned per-request span contexts —
-        one window carries many traces, so each sampled member's ctx
-        travels next to its request instead of in the ambient var."""
+    def _resolve_wave(self, entity_ids: Sequence[str],
+                      values: Sequence[float],
+                      ctxs: Optional[Sequence[Any]]):
+        """Shared wave prep: entity ids resolved ONCE per unique id;
+        unresolvable entities land their typed exception in `out`
+        directly; the rest compact into (shard, index, payload) requests
+        with aligned origin slots and span contexts."""
         refs: Dict[str, Any] = {}
         for e in entity_ids:
             if e not in refs:
@@ -223,9 +224,39 @@ class RegionBackend:
             slots.append(i)
             if req_ctxs is not None:
                 req_ctxs.append(ctxs[i])
+        return out, reqs, slots, req_ctxs
+
+    def ask_many(self, entity_ids: Sequence[str],
+                 values: Sequence[float],
+                 ctxs: Optional[Sequence[Any]] = None,
+                 with_seqs: bool = False):
+        """Columnar wave ask for a decoded binary window: entity ids are
+        resolved ONCE per unique id, the whole wave rides
+        `AskBatcher.ask_many` (one coalesced flush + one shared step
+        budget, no per-call future hop) and the return is outcome-
+        aligned — a float total or the per-ask exception INSTANCE
+        (AskPoolExhausted / TimeoutError / ...), never a raise, so one
+        member's failure cannot fail its wave-mates.
+
+        `ctxs` (ISSUE 12): optional aligned per-request span contexts —
+        one window carries many traces, so each sampled member's ctx
+        travels next to its request instead of in the ambient var.
+
+        `with_seqs` (ISSUE 16): also return the aligned per-member
+        resolve ordinals (continuous mode; None under the serialized
+        engine, where waves already resolve in submit order) — the
+        gateway's replica-publish monotonicity key."""
+        out, reqs, slots, req_ctxs = self._resolve_wave(
+            entity_ids, values, ctxs)
+        seqs_out: Optional[List[int]] = None
         if reqs:
+            rseqs = None
             if self.batcher is not None:
-                replies = self.batcher.ask_many(reqs, req_ctxs)
+                if with_seqs:
+                    replies, rseqs = self.batcher.ask_many(
+                        reqs, req_ctxs, with_seqs=True)
+                else:
+                    replies = self.batcher.ask_many(reqs, req_ctxs)
             else:
                 replies = self.region.ask_many(
                     reqs, steps=self.steps,
@@ -233,7 +264,37 @@ class RegionBackend:
             for i, rep in zip(slots, replies):
                 out[i] = rep if isinstance(rep, BaseException) \
                     else float(np.asarray(rep)[0])
-        return out
+            if rseqs is not None:
+                seqs_out = [0] * len(entity_ids)
+                for i, s in zip(slots, rseqs):
+                    seqs_out[i] = int(s)
+        return (out, seqs_out) if with_seqs else out
+
+    def ask_many_async(self, entity_ids: Sequence[str],
+                       values: Sequence[float],
+                       ctxs: Optional[Sequence[Any]],
+                       on_done: Callable[[List[Any], List[int]], Any]
+                       ) -> None:
+        """Continuous-mode async wave (ISSUE 16): refs resolve and the
+        wave STAGES on the calling thread (staging order is the
+        linearization order, so per-connection ordering is preserved);
+        `on_done(outcomes, seqs)` — both aligned with `entity_ids` —
+        fires at the wave's resolve boundary on the scheduler thread."""
+        out, reqs, slots, req_ctxs = self._resolve_wave(
+            entity_ids, values, ctxs)
+        seqs_out = [0] * len(entity_ids)
+        if not reqs:
+            on_done(out, seqs_out)
+            return
+
+        def _done(replies: List[Any], rseqs: List[int]) -> None:
+            for i, rep, s in zip(slots, replies, rseqs):
+                out[i] = rep if isinstance(rep, BaseException) \
+                    else float(np.asarray(rep)[0])
+                seqs_out[i] = int(s)
+            on_done(out, seqs_out)
+
+        self.batcher.ask_many_async(reqs, req_ctxs, _done)
 
     def close(self) -> None:
         if self.batcher is not None:
@@ -242,6 +303,11 @@ class RegionBackend:
     def sum_all(self) -> float:
         """Conserved-value probe: sum of every spawned entity's total."""
         region = self.region
+        if self.batcher is not None:
+            # continuous mode: open waves resolve before the probe reads
+            # device state (serialized engine calls are synchronous under
+            # the ask lock below, so this is a no-op there)
+            self.batcher.quiesce()
         with region._ask_lock:  # quiesce vs concurrent asks/maintenance
             return self._sum_locked(region)
 
@@ -292,6 +358,26 @@ class _WindowAux:
         self.reasons_full: Dict[int, str] = {}  # row -> untruncated reason
 
 
+class _ServeState:
+    """One record window's staged serve state, crossing the
+    stage/resolve seam (ISSUE 16): the reply columns being filled, the
+    per-row trace roots, the deferred SLO rounds, and the compacted ask
+    wave (`serve` row indices with aligned vals/ents/ctxs). The
+    synchronous path builds and consumes it on one thread; the
+    continuous path hands it from the staging thread to the wave
+    scheduler's resolve boundary."""
+
+    __slots__ = ("aux", "ids", "ops", "tenants", "status", "reason",
+                 "value", "retry", "step_lag", "traces", "roots",
+                 "slo_outcomes", "slo_lat", "slo_rep", "serve", "vals",
+                 "ents", "ctxs")
+
+    def __init__(self) -> None:
+        self.slo_outcomes: Dict[bytes, List[str]] = {}
+        self.slo_lat: Dict[bytes, List[Optional[float]]] = {}
+        self.slo_rep: Dict[bytes, List[bool]] = {}
+
+
 # ------------------------------------------------------------------- server
 class GatewayServer:
     """The front door: admission -> SLO clock -> backend ask, over TCP
@@ -331,6 +417,15 @@ class GatewayServer:
         self._registry = registry
         self.pipeline_depth = int(pipeline_depth)
         self._conn_ids = itertools.count(1)
+        # continuous wave formation (ISSUE 16): autodetected from the
+        # backend's batcher. When on, windows may resolve out of submit
+        # order, so replica publishes are filtered per entity by resolve
+        # ordinal — a slow wave's stale total must never overwrite a
+        # newer wave's published one.
+        self.continuous = bool(getattr(
+            getattr(backend, "batcher", None), "continuous", False))
+        self._pub_lock = threading.Lock()
+        self._pub_seq: Dict[str, int] = {}
         # causal tracing (event/tracing.py): explicit tracer wins, else
         # the system-wired one (akka.tracing.* config); None keeps every
         # hook below at one `is not None` predicate
@@ -493,6 +588,66 @@ class GatewayServer:
         1:1 with `bodies`, each in its own encoding; window row order is
         arrival order, so per-entity linearization order is frame order
         (the wave scheduler serves duplicate destinations in row order)."""
+        out, windowed, spans, count_of, rec, aux, decode_t = \
+            self._window_prep(bodies)
+        if not windowed:
+            return out  # type: ignore[return-value]
+        t_serve0 = time.monotonic() if self._tracer is not None else 0.0
+        cols = self._serve_records(rec, decode_t, aux)
+        self._window_demux(out, windowed, spans, count_of, cols, aux,
+                           t_serve0)
+        return out  # type: ignore[return-value]
+
+    def submit_frames(self, bodies: Sequence[bytes]) -> "Future":
+        """Continuous-mode async twin of `handle_frame_batch` (ISSUE 16
+        tentpole): decode + admission + replica reads + wave STAGING run
+        on the caller's thread (arrival order stays the linearization
+        order), then this returns a Future of the aligned reply bodies
+        immediately — outcome columns, replica publishes, SLO rounds and
+        reply encode all run at the wave's resolve boundary on the
+        scheduler thread. The caller (IngestAggregator) is then free to
+        decode and admission-charge window N+1 while window N's device
+        rounds are still in flight."""
+        fut: Future = Future()
+        try:
+            out, windowed, spans, count_of, rec, aux, decode_t = \
+                self._window_prep(bodies)
+            if not windowed:
+                fut.set_result(out)
+                return fut
+            t_serve0 = time.monotonic() if self._tracer is not None \
+                else 0.0
+            st = self._serve_stage(rec, decode_t, aux)
+            if not len(st.serve):
+                cols = self._serve_resolve(st, [], 0.0)
+                self._window_demux(out, windowed, spans, count_of, cols,
+                                   aux, t_serve0)
+                fut.set_result(out)
+                return fut
+            t0 = time.perf_counter()
+
+            def _done(outcomes: List[Any], seqs: List[int]) -> None:
+                try:
+                    cols = self._serve_resolve(
+                        st, outcomes, time.perf_counter() - t0, seqs)
+                    self._window_demux(out, windowed, spans, count_of,
+                                       cols, aux, t_serve0)
+                    fut.set_result(out)
+                except BaseException as e:  # noqa: BLE001 — never hang
+                    fut.set_exception(e)
+
+            self.backend.ask_many_async(st.ents, st.vals, st.ctxs, _done)
+        except BaseException as e:  # noqa: BLE001 — never hang the caller
+            fut.set_exception(e)
+        return fut
+
+    def _window_prep(self, bodies: Sequence[bytes]):
+        """Frame demux + merged decode + arrival-order row spans + mixed
+        columnization — everything in `_serve_frames` upstream of the
+        serve pass, shared with the async `submit_frames` path. Returns
+        `(out, windowed, spans, count_of, rec, aux, decode_t)`; empty
+        `windowed` means every frame was answered standalone and `out`
+        is already complete."""
         n_f = len(bodies)
         out: List[Optional[bytes]] = [None] * n_f
         bin_idx: List[int] = []     # frame index per valid binary body
@@ -521,7 +676,7 @@ class GatewayServer:
                 continue
             json_reqs[f] = req
         if not bin_bodies and not json_reqs:
-            return out  # type: ignore[return-value]
+            return out, [], {}, {}, None, None, None
 
         # ---- merged decode: ONE frombuffer for the window's binary rows
         tr = self._tracer
@@ -559,17 +714,26 @@ class GatewayServer:
         else:
             rec, aux = self._columnize_mixed(rec_bin, bin_idx, spans,
                                              json_reqs, n)
+        return out, windowed, spans, count_of, rec, aux, decode_t
 
-        t_serve0 = time.monotonic() if tr is not None else 0.0
-        ids, status, reason, value, retry, traces, step_lag = \
-            self._serve_records(rec, decode_t, aux)
-
+    def _window_demux(self, out: List[Optional[bytes]],
+                      windowed: List[int],
+                      spans: Dict[int, Tuple[int, int]],
+                      count_of: Dict[int, int], cols, aux,
+                      t_serve0: float) -> None:
+        """Reply columns back to per-frame bodies, each in its own
+        encoding, plus the window-level join span. Runs on the serving
+        thread in the synchronous path and at the wave's resolve
+        boundary in the continuous path."""
+        ids, status, reason, value, retry, traces, step_lag = cols
+        tr = self._tracer
         if tr is not None and traces is not None and len(windowed) > 1:
             member = [int(t) for t in traces if t]
             if member:  # window-level join span, the ask.wave convention
                 tr.emit("gw.ingest_window", member[0], t0=t_serve0,
                         t1=time.monotonic(), n_frames=len(windowed),
-                        n_records=n, member_traces=member)
+                        n_records=spans[windowed[-1]][1],
+                        member_traces=member)
 
         # ---- demux: each frame's reply slice in its own encoding
         for f in windowed:
@@ -584,7 +748,6 @@ class GatewayServer:
                 out[f] = encode_body(self._row_reply(
                     lo, ids, status, reason, value, retry, traces, aux,
                     step_lag))
-        return out  # type: ignore[return-value]
 
     @staticmethod
     def _columnize_mixed(rec_bin, bin_idx: List[int],
@@ -699,6 +862,12 @@ class GatewayServer:
         charged. SLO counters are recorded per tenant with
         `record_many` — counter-identical to N scalar requests.
 
+        Split at the stage/resolve seam (ISSUE 16): `_serve_stage` does
+        everything UP TO the ask wave, `_serve_resolve` everything after
+        it; this synchronous composition is the serialized serve path,
+        bit-identical to PR 15, and `submit_frames` recomposes the same
+        halves around an async continuous wave.
+
         `aux` (ISSUE 13) carries the JSON overlays of a mixed window:
         raw reply ids, op-label strings for span attrs and unknown_op
         reasons, and untruncated reasons for JSON replies.
@@ -709,32 +878,59 @@ class GatewayServer:
         wave, and the reply wave carries the trace-id column (version-2
         records) when any record was sampled. Tracing off ⇒ one
         predicate, identical columns, version-1 bytes."""
+        st = self._serve_stage(rec, decode_t, aux)
+        outcomes: List[Any] = []
+        dt = 0.0
+        seqs: Optional[List[int]] = None
+        if len(st.serve):
+            t0 = time.perf_counter()
+            if self.continuous:
+                # even the synchronous path needs resolve ordinals when
+                # waves overlap: concurrent handle_frame threads resolve
+                # out of submit order under the continuous scheduler
+                outcomes, seqs = self.backend.ask_many(
+                    st.ents, st.vals, st.ctxs, with_seqs=True)
+            else:
+                outcomes = self._backend_ask_many(st.ents, st.vals,
+                                                  st.ctxs)
+            dt = time.perf_counter() - t0
+        return self._serve_resolve(st, outcomes, dt, seqs)
+
+    def _serve_stage(self, rec: np.ndarray, decode_t=None,
+                     aux: Optional[_WindowAux] = None) -> "_ServeState":
+        """Stage phase: reply columns allocated, traces rooted, typed
+        admin/missing checks, the vectorized admission charge, unknown-op
+        typing, replica reads — ending with the compacted serve rows
+        (`st.serve/vals/ents/ctxs`) ready to ride an ask wave."""
         n = len(rec)
-        ids = rec["id"].astype(np.int64)
-        ops = rec["op"]
-        tenants = rec["tenant"]
+        st = _ServeState()
+        st.aux = aux
+        st.ids = rec["id"].astype(np.int64)
+        ops = st.ops = rec["op"]
+        tenants = st.tenants = rec["tenant"]
         entities = rec["entity"]
-        status = np.full((n,), frames.ST_ERROR, np.uint8)
-        reason = np.zeros((n,), f"S{frames.REASON_BYTES}")
-        value = np.zeros((n,), np.float64)
-        retry = np.zeros((n,), np.uint32)
-        step_lag = np.full((n,), -1, np.int32)  # >=0 <=> replica-served
+        status = st.status = np.full((n,), frames.ST_ERROR, np.uint8)
+        reason = st.reason = np.zeros((n,), f"S{frames.REASON_BYTES}")
+        value = st.value = np.zeros((n,), np.float64)
+        retry = st.retry = np.zeros((n,), np.uint32)
+        # >=0 <=> replica-served
+        step_lag = st.step_lag = np.full((n,), -1, np.int32)
 
         tr = self._tracer
-        traces = None
-        roots: Dict[int, Any] = {}
+        st.traces = None
+        roots = st.roots = {}
         if tr is not None:
-            traces = np.zeros((n,), np.uint64)
+            st.traces = np.zeros((n,), np.uint64)
             for i in range(n):
                 is_json = aux is not None and i in aux.json_rows
                 rid: Any = aux.raw_ids.get(i, _MISSING) if is_json \
                     else _MISSING
                 if rid is _MISSING:
-                    rid = int(ids[i])
+                    rid = int(st.ids[i])
                 tid = tr.start_trace(
                     tenants[i].decode("utf-8", "replace"), rid)
                 if tid:
-                    traces[i] = tid
+                    st.traces[i] = tid
                     roots[i] = tr.begin(
                         "gw.request", tid, id=rid,
                         tenant=tenants[i].decode("utf-8", "replace"),
@@ -753,26 +949,6 @@ class GatewayServer:
         missing = ~admin & (entities == b"")
         reason[missing] = b"bad_request:missing_entity"
         eligible = ~admin & ~missing
-
-        slo_outcomes: Dict[bytes, List[str]] = {}
-        slo_lat: Dict[bytes, List[Optional[float]]] = {}
-        slo_rep: Dict[bytes, List[bool]] = {}
-
-        def note(t: bytes, outcome: str, lat: Optional[float] = None,
-                 count: int = 1, replica: bool = False) -> None:
-            slo_outcomes.setdefault(t, []).extend([outcome] * count)
-            slo_lat.setdefault(t, []).extend([lat] * count)
-            slo_rep.setdefault(t, []).extend([replica] * count)
-
-        def set_reason(i, full: str) -> None:
-            # wire truncation on the column; JSON replies keep the full
-            # string through the aux overlay (the scalar path never
-            # truncated, so neither does its windowed twin)
-            b = full.encode("utf-8")
-            reason[i] = b[:frames.REASON_BYTES]
-            if (aux is not None and len(b) > frames.REASON_BYTES
-                    and i in aux.json_rows):
-                aux.reasons_full[int(i)] = full
 
         # ---- vectorized per-tenant admission charge: ONE pressure poll
         # for the whole window, one bucket debit per tenant
@@ -798,7 +974,7 @@ class GatewayServer:
                 reason[shed] = rej.reason.encode("utf-8") \
                     [:frames.REASON_BYTES]
                 retry[shed] = int(rej.retry_after_s * 1e3)
-                note(t, "reject", count=len(shed))
+                self._note(st, t, "reject", count=len(shed))
         if aspan is not None:
             aspan.finish(admitted=int(admitted.sum()))
 
@@ -811,10 +987,10 @@ class GatewayServer:
             if full is None:
                 lbl = aux.op_labels.get(i) if aux is not None else None
                 full = f"unknown_op:{lbl if lbl is not None else int(ops[i])}"
-            set_reason(i, full)
-            note(tenants[i], "error")
+            self._set_reason(st, i, full)
+            self._note(st, tenants[i], "error")
         for i in np.nonzero(missing)[0]:
-            note(tenants[i], "error")
+            self._note(st, tenants[i], "error")
 
         # ---- replicated read path (ISSUE 14): hot-entity gets answered
         # from the local replica BEFORE the ask wave, strictly after the
@@ -837,7 +1013,7 @@ class GatewayServer:
             if replica_rows:
                 dtr = time.perf_counter() - t0r
                 for i in replica_rows:
-                    note(tenants[i], "ok", dtr, replica=True)
+                    self._note(st, tenants[i], "ok", dtr, replica=True)
                     sp = roots.get(i)
                     if sp is not None:  # parented under gw.request; the
                         # fall-through rows keep their ask.member spans
@@ -846,22 +1022,33 @@ class GatewayServer:
                 keep = ~np.isin(serve, replica_rows)
                 serve = serve[keep]
 
-        # ---- ONE ask wave for the whole admitted window
-        if len(serve):
-            vals = np.where(ops[serve] == frames.OP_ADD,
-                            rec["value"][serve].astype(np.float64), 0.0)
-            ents = [entities[i].decode("utf-8") for i in serve]
-            ctxs = None
-            if roots:  # each sampled request's ctx rides with its ask
-                ctxs = [roots[i].ctx if i in roots else None
-                        for i in serve]
-            t0 = time.perf_counter()
-            outcomes = self._backend_ask_many(ents, vals, ctxs)
-            dt = time.perf_counter() - t0
+        st.serve = serve
+        st.vals = np.where(ops[serve] == frames.OP_ADD,
+                           rec["value"][serve].astype(np.float64), 0.0)
+        st.ents = [entities[i].decode("utf-8") for i in serve]
+        st.ctxs = None
+        if roots:  # each sampled request's ctx rides with its ask
+            st.ctxs = [roots[i].ctx if i in roots else None
+                       for i in serve]
+        return st
+
+    def _serve_resolve(self, st: "_ServeState", outcomes: List[Any],
+                       dt: float, seqs: Optional[List[int]] = None):
+        """Resolve phase: ask outcomes -> reply columns, replica
+        publishes (seq-filtered when waves overlap), SLO rounds, root
+        span finish. Runs on the serving thread in the synchronous path
+        and on the scheduler thread at the wave's resolve boundary in
+        the continuous path."""
+        status, reason, value, retry = st.status, st.reason, st.value, \
+            st.retry
+        cache = self.replica_cache
+        if len(st.serve):
             pool_noted = False
             wave_totals: Dict[str, float] = {}
-            for i, outc, ent in zip(serve, outcomes, ents):
-                t = tenants[i]
+            wave_seqs: Dict[str, int] = {}
+            for j, (i, outc, ent) in enumerate(
+                    zip(st.serve, outcomes, st.ents)):
+                t = st.tenants[i]
                 if isinstance(outc, AskPoolExhausted):
                     if not pool_noted:
                         self.admission.note_ask_pool_exhausted()
@@ -869,40 +1056,84 @@ class GatewayServer:
                     status[i] = frames.ST_SHED
                     reason[i] = b"ask_pool_exhausted"
                     retry[i] = int(self.admission.cooldown_s * 1e3)
-                    note(t, "reject")
+                    self._note(st, t, "reject")
                 elif isinstance(outc, TimeoutError):
                     reason[i] = b"timeout"
-                    note(t, "timeout", dt)
+                    self._note(st, t, "timeout", dt)
                 elif isinstance(outc, BaseException):
-                    set_reason(i, f"fault:{type(outc).__name__}")
-                    note(t, "error", dt)
+                    self._set_reason(st, i, f"fault:{type(outc).__name__}")
+                    self._note(st, t, "error", dt)
                 else:
                     status[i] = frames.ST_OK
                     value[i] = outc
-                    note(t, "ok", dt)
+                    self._note(st, t, "ok", dt)
                     # last ok outcome per entity wins: rows are in wave
                     # linearization order, so this IS the post-wave total
                     wave_totals[ent] = float(outc)
+                    if seqs is not None:
+                        wave_seqs[ent] = int(seqs[j])
             if cache is not None and wave_totals:
                 # ONE batched publish per ask wave (the coalesced-flush
                 # boundary): authoritative totals re-arm the replica —
                 # including for reads that just fell through as stale
-                cache.publish_wave(wave_totals)
+                if seqs is None:
+                    cache.publish_wave(wave_totals)
+                else:
+                    self._publish_filtered(wave_totals, wave_seqs)
 
-        for t, outs in slo_outcomes.items():
-            self.slo.record_many(t.decode("utf-8"), outs, slo_lat[t],
-                                 slo_rep[t])
-        if roots:
+        for t, outs in st.slo_outcomes.items():
+            self.slo.record_many(t.decode("utf-8"), outs, st.slo_lat[t],
+                                 st.slo_rep[t])
+        if st.roots:
             st_names = {frames.ST_OK: "ok", frames.ST_SHED: "shed",
                         frames.ST_ERROR: "error"}
-            for i, sp in roots.items():
+            aux = st.aux
+            for i, sp in st.roots.items():
                 full = aux.reasons_full.get(i) if aux is not None else None
                 rsn = full if full is not None else \
                     bytes(reason[i]).rstrip(b"\x00") \
                     .decode("utf-8", "replace")
                 sp.finish(status=st_names.get(int(status[i]), "error"),
                           **({"reason": rsn} if rsn else {}))
-        return ids, status, reason, value, retry, traces, step_lag
+        return st.ids, status, reason, value, retry, st.traces, \
+            st.step_lag
+
+    def _publish_filtered(self, totals: Dict[str, float],
+                          wave_seqs: Dict[str, int]) -> None:
+        """Per-entity monotone replica publish for overlapping waves
+        (ISSUE 16): a wave that resolves LATE must not overwrite an
+        entity total a younger wave already published — each entity's
+        publish is gated on its members' global resolve ordinal. The
+        lock also serializes `publish_wave`'s step stamping, so the
+        cache's own step-monotonic feed contract holds too."""
+        with self._pub_lock:
+            fresh: Dict[str, float] = {}
+            for e, tot in totals.items():
+                s = wave_seqs.get(e, 0)
+                if s > self._pub_seq.get(e, -1):
+                    self._pub_seq[e] = s
+                    fresh[e] = tot
+            if fresh:
+                self.replica_cache.publish_wave(fresh)
+
+    @staticmethod
+    def _note(st: "_ServeState", t: bytes, outcome: str,
+              lat: Optional[float] = None, count: int = 1,
+              replica: bool = False) -> None:
+        st.slo_outcomes.setdefault(t, []).extend([outcome] * count)
+        st.slo_lat.setdefault(t, []).extend([lat] * count)
+        st.slo_rep.setdefault(t, []).extend([replica] * count)
+
+    @staticmethod
+    def _set_reason(st: "_ServeState", i, full: str) -> None:
+        # wire truncation on the column; JSON replies keep the full
+        # string through the aux overlay (the scalar path never
+        # truncated, so neither does its windowed twin)
+        b = full.encode("utf-8")
+        st.reason[i] = b[:frames.REASON_BYTES]
+        if (st.aux is not None and len(b) > frames.REASON_BYTES
+                and i in st.aux.json_rows):
+            st.aux.reasons_full[int(i)] = full
 
     def _backend_ask_many(self, entity_ids: List[str],
                           values: np.ndarray,

@@ -25,7 +25,7 @@ from .typed import (ClusterShardingTyped, Entity, EntityContext, EntityRef,
                     EntityTypeKey)
 from .daemon_process import (ShardedDaemonProcess,
                              ShardedDaemonProcessSettings)
-from .ask_batch import AskBatcher
+from .ask_batch import AskBatcher, ContinuousWaveScheduler
 
 __all__ = [
     "ShardingEnvelope", "StartEntity", "StartEntityAck", "Passivate",
@@ -41,5 +41,5 @@ __all__ = [
     "ClusterShardingTyped", "Entity", "EntityContext", "EntityRef",
     "EntityTypeKey",
     "ShardedDaemonProcess", "ShardedDaemonProcessSettings",
-    "AskBatcher",
+    "AskBatcher", "ContinuousWaveScheduler",
 ]

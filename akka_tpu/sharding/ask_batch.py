@@ -35,8 +35,9 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
-from typing import Any, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np
@@ -44,18 +45,30 @@ import numpy as np
 from ..event.tracing import NOOP_SPAN, current_ctx, reset_ctx, set_ctx
 
 __all__ = ["BatchAsk", "execute_ask_batch", "AskBatcher",
-           "wait_adaptive_close"]
+           "ContinuousWaveScheduler", "wait_adaptive_close"]
 
 
 def wait_adaptive_close(work: threading.Event, window_s: float,
-                        full) -> None:
+                        full, idle=None) -> None:
     """THE adaptive window-close wait, shared by the ask dispatcher and
     the ingest aggregator (gateway/aggregator.py): block until `full()`
     says the window is worth closing or `window_s` has elapsed since the
     window opened — whichever first — waking early whenever `work` is
-    set by a new arrival. `full` must take its own lock."""
+    set by a new arrival. `full` must take its own lock.
+
+    `idle` (ISSUE 16 satellite): optional predicate saying the pipeline
+    downstream of this window has nothing in flight. When it holds, the
+    window closes IMMEDIATELY — a lone request under light load must not
+    eat the whole adaptive window when no concurrent work could possibly
+    coalesce with it. Under load the predicate is False (a wave/window
+    is executing) and the adaptive wait behaves exactly as before: the
+    execution time of the in-flight work IS the batching window.
+    Callers must set `work` whenever `idle` transitions to True, or a
+    request arriving mid-flight waits the full deadline."""
     deadline = time.perf_counter() + window_s
     while not full():
+        if idle is not None and idle():
+            return
         remain = deadline - time.perf_counter()
         if remain <= 0:
             return
@@ -74,7 +87,8 @@ class BatchAsk:
 
     __slots__ = ("shard", "index", "message", "steps", "max_extra_steps",
                  "slot", "prow", "row", "start", "outcome", "future",
-                 "t_submit", "trace", "t_stage", "step_stage")
+                 "t_submit", "trace", "t_stage", "step_stage", "wave",
+                 "was_deferred", "resolve_seq")
 
     def __init__(self, shard: int, index: int, message: Any,
                  steps: int = 2, max_extra_steps: int = 8,
@@ -94,6 +108,15 @@ class BatchAsk:
         self.trace = trace
         self.t_stage = 0.0
         self.step_stage = 0
+        # continuous wave scheduling (ISSUE 16): owning wave handle, the
+        # per-wave deferred marker (the engine infers it from `start`,
+        # which is a GLOBAL step count under the scheduler), and the
+        # global resolve ordinal of an ok outcome — what lets the
+        # gateway's replica publishes stay per-entity monotone when wave
+        # resolve boundaries complete out of submit order
+        self.wave = None
+        self.was_deferred = False
+        self.resolve_seq = 0
 
 
 def _reset_batch_latches(region, slots: Sequence[int]) -> None:
@@ -113,25 +136,18 @@ def _reset_batch_latches(region, slots: Sequence[int]) -> None:
     sys.state["__promise_replied"] = col.at[base:base + eps].set(blk)
 
 
-def execute_ask_batch(region, batch: Sequence[BatchAsk]) -> None:
-    """Run a batch of asks through shared step rounds. Caller holds
-    `region._ask_lock`. Fills each member's `.outcome` with the reply
-    payload (np.ndarray) or an exception instance (AskPoolExhausted /
-    ValueError / TimeoutError) — never raises for per-ask conditions, so
-    one member's timeout cannot fail its batch-mates."""
+def _assemble_slots(region, batch: Sequence[BatchAsk]) -> List[BatchAsk]:
+    """Stage-phase slot assembly (shared by the serialized engine and the
+    continuous scheduler — ISSUE 16 split): one promise slot per member;
+    pool overflow is a typed per-member fast-fail (the admission layer
+    sheds on it), not a batch failure. Caller holds `region._ask_lock`.
+    Returns the live members, each with slot/prow/row assigned."""
     from ..batched.bridge import AskPoolExhausted, max_exact_row_id
-    from ..batched.supervision import decode_attention
 
-    region._ensure_promise_rows()
-    region._reclaim_promise_slots()  # once per BATCH, not once per ask
     sys = region.system
     eps = region.eps
     base = region._promise_block * eps
     limit = max_exact_row_id(sys.payload_dtype)
-
-    # -- assembly: one promise slot per member; pool overflow is a typed
-    # per-member fast-fail (the admission layer sheds on it), not a batch
-    # failure
     live: List[BatchAsk] = []
     for a in batch:
         with region._lock:
@@ -154,6 +170,41 @@ def execute_ask_batch(region, batch: Sequence[BatchAsk]) -> None:
         a.prow = prow
         a.row = region.row_of(a.shard, a.index)
         live.append(a)
+    return live
+
+
+def _stage_tell(sys, a: BatchAsk, cum: int) -> None:
+    """Stage ONE ask's tell into the next flush (shared stage phase):
+    payload body + reply-to promise row in the last column, `start`
+    stamped with the step count the timeout clock runs against."""
+    payload = np.zeros((sys.payload_width,), np.float32)
+    body = np.atleast_1d(
+        np.asarray(a.message, np.float32)).reshape(-1)
+    payload[:min(len(body), sys.payload_width - 1)] = \
+        body[:sys.payload_width - 1]
+    payload[-1] = float(a.prow)
+    sys.tell(a.row, payload)
+    a.start = cum
+    if a.trace is not None:
+        a.t_stage = time.monotonic()
+        a.step_stage = int(sys._host_step)
+
+
+def execute_ask_batch(region, batch: Sequence[BatchAsk]) -> None:
+    """Run a batch of asks through shared step rounds. Caller holds
+    `region._ask_lock`. Fills each member's `.outcome` with the reply
+    payload (np.ndarray) or an exception instance (AskPoolExhausted /
+    ValueError / TimeoutError) — never raises for per-ask conditions, so
+    one member's timeout cannot fail its batch-mates."""
+    from ..batched.supervision import decode_attention
+
+    region._ensure_promise_rows()
+    region._reclaim_promise_slots()  # once per BATCH, not once per ask
+    sys = region.system
+    eps = region.eps
+    base = region._promise_block * eps
+
+    live = _assemble_slots(region, batch)
     if not live:
         return
 
@@ -177,6 +228,14 @@ def execute_ask_batch(region, batch: Sequence[BatchAsk]) -> None:
     cum = 0  # steps run so far in this batch
     rounds = 0
     try:
+        # stage/resolve phase attribution (ISSUE 16 satellite): the three
+        # coarse children — wave.stage (latch reset + coalesced flush),
+        # wave.inflight_wait (the step rounds) and wave.resolve (journal
+        # commit) — retro-emitted around the existing fine-grained kids,
+        # so the bench artifact shows where a serialized wave's latency
+        # actually lives. Quiet path: tracer None or unsampled wave keeps
+        # the one-predicate cost (emit on a None ctx is a no-op).
+        t_stage0 = time.monotonic() if tracer is not None else 0.0
         with wspan.child("wave.latch_reset", wave_id=wave_id):
             _reset_batch_latches(region, [a.slot for a in live])
 
@@ -194,17 +253,7 @@ def execute_ask_batch(region, batch: Sequence[BatchAsk]) -> None:
                 if a.row in in_flight:
                     rest.append(a)
                     continue
-                payload = np.zeros((sys.payload_width,), np.float32)
-                body = np.atleast_1d(
-                    np.asarray(a.message, np.float32)).reshape(-1)
-                payload[:min(len(body), sys.payload_width - 1)] = \
-                    body[:sys.payload_width - 1]
-                payload[-1] = float(a.prow)
-                sys.tell(a.row, payload)
-                a.start = cum
-                if a.trace is not None:
-                    a.t_stage = time.monotonic()
-                    a.step_stage = int(sys._host_step)
+                _stage_tell(sys, a, cum)
                 in_flight[a.row] = a
             waiting = rest
 
@@ -221,6 +270,11 @@ def execute_ask_batch(region, batch: Sequence[BatchAsk]) -> None:
         with wspan.child("wave.flush", wave_id=wave_id, coalesced=True,
                          n_staged=len(waiting)):
             stage_ready()
+        t_wait0 = time.monotonic() if tracer is not None else 0.0
+        if tracer is not None:
+            tracer.emit("wave.stage", wspan.ctx, t0=t_stage0, t1=t_wait0,
+                        wave_id=wave_id, n_staged=len(in_flight),
+                        n_deferred=len(waiting))
         first = True
         rounds = 0
         while in_flight:
@@ -281,6 +335,11 @@ def execute_ask_batch(region, batch: Sequence[BatchAsk]) -> None:
                                  deferred=True, n_staged=len(waiting)):
                     stage_ready()
 
+        t_res0 = time.monotonic() if tracer is not None else 0.0
+        if tracer is not None:
+            tracer.emit("wave.inflight_wait", wspan.ctx, t0=t_wait0,
+                        t1=t_res0, wave_id=wave_id, rounds=rounds)
+
         # durable entity layer (ISSUE 15): ONE group-committed journal
         # write for the whole wave's ok events, BEFORE outcomes reach the
         # callers — an acked write is on disk by the time the ack exists.
@@ -291,8 +350,447 @@ def execute_ask_batch(region, batch: Sequence[BatchAsk]) -> None:
                              n_events=len(ok_resolved)):
                 region._commit_entity_events(
                     [(a.shard, a.index, a.message) for a in ok_resolved])
+        if tracer is not None:
+            tracer.emit("wave.resolve", wspan.ctx, t0=t_res0,
+                        t1=time.monotonic(), wave_id=wave_id,
+                        n_ok=len(ok_resolved))
     finally:
         wspan.finish(rounds=rounds, steps=cum)
+
+
+class _WaveHandle:
+    """One wave open on the continuous scheduler: completion latch,
+    resolve-boundary callback, wave span, and the members' resolve
+    bookkeeping. `done` is set strictly AFTER the wave's journal group
+    commit and after every member future holds its outcome."""
+
+    __slots__ = ("batch", "remaining", "ok", "done", "on_resolve",
+                 "wspan", "wave_id", "t_stage1")
+
+    def __init__(self, batch: List[BatchAsk]):
+        self.batch = batch
+        self.remaining = 0
+        self.ok: List[BatchAsk] = []  # replied members, resolve order
+        self.done = threading.Event()
+        self.on_resolve: Optional[Callable[["_WaveHandle"], None]] = None
+        self.wspan = NOOP_SPAN
+        self.wave_id = 0
+        self.t_stage1 = 0.0
+
+    def outcomes(self) -> List[Any]:
+        return [a.outcome for a in self.batch]
+
+
+class ContinuousWaveScheduler:
+    """Continuous wave formation (ISSUE 16 tentpole): overlap wave N+1's
+    staging with wave N's device rounds.
+
+    The serialized engine holds `region._ask_lock` for a whole
+    stage→step→poll round, so concurrent waves pay their device rounds
+    back to back — the authoritative-latency floor the PR 14 A/B
+    measured (208 ms p99 at 64 clients). This scheduler splits the
+    engine at its stage/resolve seam:
+
+    - `submit_wave` holds the lock only for the STAGING INSTANT (slot
+      assembly, latch reset, coalesced tell flush) and returns a handle
+      immediately — the submitting thread is free to decode and
+      admission-charge the next window while the device runs.
+    - ONE runner thread drives shared single-step rounds for ALL open
+      waves, keeping up to `depth` dispatched rounds in flight on the
+      bridge (PR 3's enqueue-ahead deque of non-donated attention
+      words; the device_get on the oldest handle doubles as that
+      round's sync) and paying the wide promise-block readback only
+      when the packed attention word says some latch is actually high.
+    - members of EVERY open wave resolve off the same readback as their
+      latches land; a wave's resolve boundary (journal group commit →
+      member futures → `on_resolve`) fires when its LAST member
+      retires, preserving the PR 15 commit-before-ack ordering per
+      wave.
+
+    Cross-wave scheduling rule: the dense-inbox reduce still SUMS
+    payloads, so the one-in-flight-ask-per-destination-row rule extends
+    across waves — `_row_owner` maps each destination row to its single
+    in-flight ask and `_deferred` holds the row's FIFO of late joiners
+    (from the SAME wave or any later one), staged into the next step
+    round the moment the row frees. Per-entity linearization is
+    therefore submit order, exactly as under the serialized engine.
+
+    Locking: every piece of scheduler wave state (_row_owner, _deferred,
+    _waves, _cum, _resolve_seq) is mutated only under `region._ask_lock`
+    — the same lock checkpoint/rebalance/failover/sum already take, so
+    maintenance ops interleave between rounds instead of between waves.
+    `self._lock` guards only the overlap statistics."""
+
+    def __init__(self, region, depth: int = 4):
+        self.region = region
+        self.depth = max(1, int(depth))
+        # attention rounds kept in flight ahead of the drain: 2 is the
+        # bridge pump's sweet spot (dispatch round k+1 while round k
+        # syncs); deeper only delays resolution within the timeout budget
+        self._ahead = min(self.depth, 2)
+        self._lock = threading.Lock()
+        self._work = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._waves: List[_WaveHandle] = []      # open waves, submit order
+        self._row_owner: Dict[int, BatchAsk] = {}
+        self._deferred: List[BatchAsk] = []      # submit-order FIFO
+        self._deferred_rows: Dict[int, int] = {}  # row -> queued count
+        self._cum = 0          # global steps this scheduler has run
+        self._att_q: deque = deque()  # (cum_at_dispatch, attention handle)
+        self._resolve_seq = 0
+        # overlap accounting (satellite: overlap_ratio in ask_batch stats)
+        self._open = 0
+        self._t_mark: Optional[float] = None
+        self._busy_s = 0.0
+        self._overlap_s = 0.0
+        self._waves_done = 0
+        # idle-transition hook (wait_adaptive_close fast-close): callers
+        # park on their own events; the scheduler pokes this when the
+        # last open wave resolves
+        self.on_idle: Optional[Callable[[], Any]] = None
+
+    # -------------------------------------------------------------- submit
+    def submit_wave(self, batch: Sequence[BatchAsk],
+                    on_resolve=None) -> _WaveHandle:
+        """Stage one wave and return immediately. The lock is held for
+        the staging instant only; rounds run on the scheduler thread.
+        Per-member typed failures (pool exhaustion, unrepresentable
+        rows) land in `.outcome` at submit, never raise. A wave with no
+        live members completes synchronously on the submitting thread
+        (journal n/a — nothing resolved ok)."""
+        region = self.region
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("ContinuousWaveScheduler is closed")
+        h = _WaveHandle(list(batch))
+        h.on_resolve = on_resolve
+        tracer = getattr(region, "tracer", None)
+        with region._ask_lock:
+            region._ensure_promise_rows()
+            region._reclaim_promise_slots()
+            sys = region.system
+            try:
+                live = _assemble_slots(region, h.batch)
+            except BaseException as e:  # noqa: BLE001 — never half-resolve
+                for a in h.batch:
+                    if a.outcome is None:
+                        a.outcome = e
+                live = []
+            h.remaining = len(live)
+            region._wave_seq = wave_id = \
+                getattr(region, "_wave_seq", 0) + 1
+            h.wave_id = wave_id
+            if tracer is not None:
+                sampled = [a for a in live if a.trace is not None]
+                if sampled:
+                    h.wspan = tracer.begin(
+                        "ask.wave", sampled[0].trace, parent=0,
+                        wave_id=wave_id, n_members=len(live),
+                        n_sampled=len(sampled), continuous=True,
+                        member_traces=[a.trace.trace_id for a in sampled])
+            t_stage0 = time.monotonic() if tracer is not None else 0.0
+            staged = 0
+            if live:
+                with h.wspan.child("wave.latch_reset", wave_id=wave_id):
+                    _reset_batch_latches(region, [a.slot for a in live])
+                for a in live:
+                    a.wave = h
+                    # a row already in flight OR with older deferred
+                    # waiters queues behind them — cross-wave FIFO per
+                    # destination row, never a queue jump
+                    if a.row in self._row_owner \
+                            or self._deferred_rows.get(a.row):
+                        a.was_deferred = True
+                        self._deferred.append(a)
+                        self._deferred_rows[a.row] = \
+                            self._deferred_rows.get(a.row, 0) + 1
+                    else:
+                        _stage_tell(sys, a, self._cum)
+                        self._row_owner[a.row] = a
+                        staged += 1
+            h.t_stage1 = time.monotonic() if tracer is not None else 0.0
+            if tracer is not None:
+                tracer.emit("wave.stage", h.wspan.ctx, t0=t_stage0,
+                            t1=h.t_stage1, wave_id=wave_id,
+                            n_staged=staged,
+                            n_deferred=h.remaining - staged)
+            if h.remaining:
+                self._waves.append(h)
+                self._mark_open(+1)
+        if not h.remaining:
+            self._complete(h)
+            return h
+        with self._lock:
+            if self._thread is None:
+                t = threading.Thread(target=self._loop, daemon=True,
+                                     name="akka-tpu-wave-scheduler")
+                self._thread = t
+                t.start()
+        self._work.set()
+        return h
+
+    # -------------------------------------------------------------- runner
+    def _loop(self) -> None:
+        while True:
+            self._work.wait(0.25)
+            self._work.clear()
+            while True:
+                region = self.region
+                with region._ask_lock:
+                    if not self._row_owner and not self._deferred:
+                        # nothing in flight: stale pre-stage attention
+                        # snapshots resolve nobody — drop them
+                        self._att_q.clear()
+                        break
+                    sys = region.system
+                    self._stage_deferred_locked(sys)
+                    # the serialized engine's step schedule, continuous
+                    # form: when every in-flight ask still needs k > 1
+                    # steps before its reply can latch (fresh stages with
+                    # steps=2), run all k in ONE dispatch — same device
+                    # work, half the dispatch+sync round trips; any ask
+                    # whose reply could land now pins the round to 1 so
+                    # resolution is never delayed
+                    n_steps = 1
+                    if self._row_owner:
+                        n_steps = max(1, min(
+                            a.steps - (self._cum - a.start)
+                            for a in self._row_owner.values()))
+                    sys.run(n_steps)
+                    self._cum += n_steps
+                    # non-donated attention word handle: the enqueue-
+                    # ahead deque (bridge _enqueue_step idiom)
+                    self._att_q.append((self._cum, sys.attention))
+                    # bridge latency policy: once some in-flight ask has
+                    # run its full step budget, its reply may already be
+                    # latched — resolution beats enqueue-ahead, so drain
+                    # the whole deque; only fresh stages (no latchable
+                    # reply yet) keep `_ahead` rounds enqueued
+                    reply_due = any(
+                        self._cum - a.start >= a.steps
+                        for a in self._row_owner.values())
+                ahead = 1 if reply_due else self._ahead
+                while len(self._att_q) >= ahead:
+                    self._drain_one()
+            with self._lock:
+                if self._closed:
+                    return
+
+    def _stage_deferred_locked(self, sys) -> None:
+        """Admit late joiners into the NEXT step round of the open
+        schedule: deferred asks whose destination row has freed stage
+        now (coalescing into this round's single flush), in submit
+        order — the first waiter per row wins, later ones keep
+        waiting."""
+        if not self._deferred:
+            return
+        rest: List[BatchAsk] = []
+        for a in self._deferred:
+            if a.row in self._row_owner:
+                rest.append(a)
+                continue
+            _stage_tell(sys, a, self._cum)
+            self._row_owner[a.row] = a
+            n = self._deferred_rows.get(a.row, 1) - 1
+            if n:
+                self._deferred_rows[a.row] = n
+            else:
+                self._deferred_rows.pop(a.row, None)
+        self._deferred = rest
+
+    def _drain_one(self) -> None:
+        """Retire the oldest in-flight round: the tiny attention
+        device_get doubles as its sync (bridge _drain_one idiom); the
+        wide promise-block readback is paid only when the packed latch
+        bit says some reply actually landed. Resolves members of ALL
+        open waves, then fires any completed wave's resolve boundary."""
+        from ..batched.supervision import decode_attention
+
+        cum_at, att_h = self._att_q.popleft()
+        att = decode_attention(att_h)
+        region = self.region
+        finished: List[_WaveHandle] = []
+        with region._ask_lock:
+            sys = region.system
+            eps = region.eps
+            base = region._promise_block * eps
+            replied_blk = reply_blk = None
+            if att["any_latched"] or not getattr(region,
+                                                 "_ask_latch_wired", False):
+                from ..batched.bridge import read_promise_block
+                replied_blk, reply_blk = read_promise_block(
+                    sys.state, base, eps, "__promise_replied",
+                    "__promise_reply")
+            tracer = getattr(region, "tracer", None)
+            done_rows: List[int] = []
+            for row, a in self._row_owner.items():
+                h = a.wave
+                if replied_blk is not None and bool(replied_blk[a.slot]):
+                    a.outcome = np.asarray(reply_blk[a.slot])
+                    self._resolve_seq += 1
+                    a.resolve_seq = self._resolve_seq
+                    h.ok.append(a)
+                    with region._lock:
+                        region._promise_free.append(a.slot)
+                    if a.trace is not None and tracer is not None:
+                        tracer.emit(
+                            "ask.member", a.trace, t0=a.t_stage,
+                            t1=time.monotonic(), step0=a.step_stage,
+                            step1=int(sys._host_step), wave_id=h.wave_id,
+                            slot=a.slot, row=row, deferred=a.was_deferred,
+                            outcome="reply")
+                elif cum_at - a.start >= a.steps + a.max_extra_steps:
+                    # timed out: RETIRE the slot (the late reply must
+                    # land in a row no future ask will read); reclaimed
+                    # once the straggler's latch shows up — exactly the
+                    # serialized engine's semantics, counted against the
+                    # steps that had run when THIS round was dispatched
+                    with region._lock:
+                        region._promise_retired.append(a.slot)
+                    a.outcome = TimeoutError(
+                        f"ask to shard {a.shard} index {a.index} "
+                        f"unanswered after "
+                        f"{a.steps + a.max_extra_steps} steps")
+                    if a.trace is not None and tracer is not None:
+                        tracer.emit(
+                            "ask.member", a.trace, t0=a.t_stage,
+                            t1=time.monotonic(), step0=a.step_stage,
+                            step1=int(sys._host_step), wave_id=h.wave_id,
+                            slot=a.slot, row=row, deferred=a.was_deferred,
+                            outcome="timeout")
+                else:
+                    continue
+                done_rows.append(row)
+                h.remaining -= 1
+            for row in done_rows:
+                del self._row_owner[row]
+            for h in [w for w in self._waves if w.remaining == 0]:
+                self._waves.remove(h)
+                self._mark_open(-1)
+                # per-wave resolve boundary, part 1 (under the lock):
+                # the PR 15 group commit — one fsync'd record for the
+                # wave's ok events BEFORE any outcome reaches a caller
+                if h.ok and getattr(region, "_entity_journal",
+                                    None) is not None:
+                    with h.wspan.child("wave.journal", wave_id=h.wave_id,
+                                       n_events=len(h.ok)):
+                        region._commit_entity_events(
+                            [(a.shard, a.index, a.message)
+                             for a in h.ok])
+                finished.append(h)
+        for h in finished:
+            self._complete(h)
+
+    def _complete(self, h: _WaveHandle) -> None:
+        """Resolve boundary, part 2 (outside the lock): member futures,
+        the `on_resolve` callback (the gateway's reply encode / replica
+        publish / SLO round ride here), the completion latch, and the
+        wave span's stage-attribution children."""
+        region = self.region
+        tracer = getattr(region, "tracer", None)
+        t_res0 = time.monotonic()
+        if tracer is not None and h.wspan is not NOOP_SPAN:
+            tracer.emit("wave.inflight_wait", h.wspan.ctx, t0=h.t_stage1,
+                        t1=t_res0, wave_id=h.wave_id)
+        for a in h.batch:
+            if a.future is not None and not a.future.done():
+                if isinstance(a.outcome, BaseException):
+                    a.future.set_exception(a.outcome)
+                else:
+                    a.future.set_result(a.outcome)
+        if h.on_resolve is not None:
+            try:
+                h.on_resolve(h)
+            except Exception:  # noqa: BLE001 — the runner must survive
+                pass           # a resolve callback's failure
+        h.done.set()
+        with self._lock:
+            self._waves_done += 1
+        if tracer is not None and h.wspan is not NOOP_SPAN:
+            tracer.emit("wave.resolve", h.wspan.ctx, t0=t_res0,
+                        t1=time.monotonic(), wave_id=h.wave_id,
+                        n_ok=len(h.ok))
+        h.wspan.finish(n_ok=len(h.ok))
+        if self.idle():
+            cb = self.on_idle
+            if cb is not None:
+                cb()
+
+    # --------------------------------------------------------------- state
+    def idle(self) -> bool:
+        """True when no wave is open (racy read — a timing hint for the
+        adaptive window close, not a synchronization primitive)."""
+        return not self._row_owner and not self._deferred \
+            and not self._waves
+
+    def quiesce(self, timeout: float = 30.0) -> bool:
+        """Block until every open wave has resolved (conserved-value
+        probes read device state directly — they must not observe a
+        half-applied wave). Returns False on timeout."""
+        deadline = time.monotonic() + timeout
+        while not self.idle():
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(1e-3)
+        return True
+
+    def _mark_open(self, delta: int) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if self._t_mark is not None:
+                span = now - self._t_mark
+                if self._open >= 1:
+                    self._busy_s += span
+                if self._open >= 2:
+                    self._overlap_s += span
+            self._t_mark = now
+            self._open += delta
+
+    def stats(self) -> Dict[str, float]:
+        """Overlap evidence for the ask_batch collector: overlap_ratio
+        is the fraction of wave-busy wall time during which two or more
+        waves were open — 0.0 means the pipeline degenerated to the
+        serialized one-wave-at-a-time schedule."""
+        with self._lock:
+            busy, over = self._busy_s, self._overlap_s
+            return {"open_waves": float(self._open),
+                    "waves_resolved": float(self._waves_done),
+                    "busy_s": busy, "overlap_s": over,
+                    "overlap_ratio": (over / busy) if busy > 0 else 0.0}
+
+    # ----------------------------------------------------------- lifecycle
+    def close(self, timeout: float = 10.0) -> None:
+        """Drain: open waves resolve (their members reply or time out —
+        the step budget bounds the wait) before the runner exits; any
+        member still unresolved after `timeout` gets a typed RuntimeError
+        so no caller hangs on a dead scheduler."""
+        with self._lock:
+            self._closed = True
+            t = self._thread
+        self._work.set()
+        if t is not None:
+            t.join(timeout)
+        with self.region._ask_lock:
+            leftovers, self._waves = self._waves, []
+            self._row_owner.clear()
+            self._deferred = []
+            self._deferred_rows.clear()
+            # commit-before-ack holds even for a force-drained wave: its
+            # already-resolved members' events hit the journal before
+            # their outcomes reach any caller below
+            for h in leftovers:
+                if h.ok and getattr(self.region, "_entity_journal",
+                                    None) is not None:
+                    self.region._commit_entity_events(
+                        [(a.shard, a.index, a.message) for a in h.ok])
+        for h in leftovers:
+            for a in h.batch:
+                if a.outcome is None:
+                    a.outcome = RuntimeError(
+                        "ContinuousWaveScheduler is closed")
+            h.remaining = 0
+            self._complete(h)
 
 
 class AskBatcher:
@@ -312,7 +810,8 @@ class AskBatcher:
 
     def __init__(self, region, max_batch: int = 32,
                  window_s: float = 200e-6, steps: int = 2,
-                 max_extra_steps: int = 8, registry=None):
+                 max_extra_steps: int = 8, registry=None,
+                 continuous: bool = False, pipeline_depth: int = 4):
         self.region = region
         # a batch larger than the promise pool would guarantee typed
         # exhaustion for the overflow members; cap it at the pool size
@@ -323,6 +822,20 @@ class AskBatcher:
         self.max_extra_steps = int(max_extra_steps)
         self._lock = threading.Lock()
         self._work = threading.Event()
+        # continuous wave formation (ISSUE 16): waves go through the
+        # scheduler instead of running the engine inline, so up to
+        # `pipeline_depth` waves overlap on the bridge. continuous=False
+        # keeps the serialized engine path byte-for-byte (the A/B escape
+        # hatch the acceptance criteria pin).
+        self.continuous = bool(continuous)
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        self._sched: Optional[ContinuousWaveScheduler] = None
+        if self.continuous:
+            self._sched = ContinuousWaveScheduler(
+                region, depth=self.pipeline_depth)
+            self._sched.on_idle = self._work.set
+        self._inflight_sem = threading.BoundedSemaphore(self.pipeline_depth)
+        self._executing = 0  # serialized engine calls in flight (idle hint)
         self._pending: List[BatchAsk] = []
         self._thread: Optional[threading.Thread] = None
         self._closed = False
@@ -377,7 +890,8 @@ class AskBatcher:
                            max_extra_steps).result()
 
     def ask_many(self, requests: Sequence[Any],
-                 ctxs: Optional[Sequence[Any]] = None) -> List[Any]:
+                 ctxs: Optional[Sequence[Any]] = None,
+                 with_seqs: bool = False):
         """Columnar wave entry (ISSUE 11): `requests` is a sequence of
         `(shard, index, message)` decoded from one binary window.
         Returns outcomes aligned with `requests` — the reply payload or
@@ -394,22 +908,78 @@ class AskBatcher:
         region's ask lock (serialized with dispatcher batches by that
         same lock — wave linearization per entity is unchanged). A
         wave of one submits through the dispatcher as usual so it can
-        coalesce with concurrent single asks."""
+        coalesce with concurrent single asks.
+
+        Continuous mode (ISSUE 16): the wave is STAGED on the scheduler
+        and this thread blocks only on its own wave's resolve boundary —
+        other threads' waves overlap it on the bridge instead of queuing
+        behind `_ask_lock`. `with_seqs=True` additionally returns the
+        per-member resolve ordinals (aligned, 0 for failures) the
+        gateway uses to keep replica publishes per-entity monotone when
+        resolve boundaries complete out of submit order; in serialized
+        mode the seqs are None — waves resolve in submit order there, so
+        publish order needs no filter (bit-parity with PR 15)."""
         reqs = list(requests)
         if not reqs:
-            return []
+            return ([], None) if with_seqs else []
+        if self._sched is not None:
+            batch = [BatchAsk(int(s), int(i), m, self.steps,
+                              self.max_extra_steps) for s, i, m in reqs]
+            if ctxs is not None:
+                for a, c in zip(batch, ctxs):
+                    a.trace = c
+            if len(batch) == 1:
+                # a wave of one rides the dispatcher window exactly as
+                # in serialized mode, so concurrent solo asks coalesce
+                # into SHARED waves — without this, 64 solo callers
+                # would stage 64 one-member waves and pay the per-wave
+                # overhead 64 times instead of once
+                a = batch[0]
+                a.future = Future()
+                a.t_submit = time.perf_counter()
+                with self._lock:
+                    if self._closed:
+                        raise RuntimeError("AskBatcher is closed")
+                    self._pending.append(a)
+                    if self._thread is None:
+                        t = threading.Thread(
+                            target=self._loop, name="akka-tpu-ask-batcher",
+                            daemon=True)
+                        self._thread = t
+                        t.start()
+                self._work.set()
+                try:
+                    a.future.result(60.0)
+                except BaseException:  # noqa: BLE001 — outcome convention
+                    pass
+                outcomes = [a.outcome]
+                if with_seqs:
+                    return outcomes, [a.resolve_seq]
+                return outcomes
+            with self._lock:
+                if self._closed:
+                    raise RuntimeError("AskBatcher is closed")
+            handles = [self._submit_wave(batch[lo:lo + self.max_batch])
+                       for lo in range(0, len(batch), self.max_batch)]
+            for h in handles:
+                h.done.wait(60.0)
+            outcomes = [a.outcome for a in batch]
+            if with_seqs:
+                return outcomes, [a.resolve_seq for a in batch]
+            return outcomes
         if len(reqs) == 1:
             s, i, m = reqs[0]
             tok = None
             if ctxs is not None and ctxs[0] is not None:
                 tok = set_ctx(ctxs[0])  # submit() snapshots it per ask
             try:
-                return [self.ask(s, i, m)]
+                out = [self.ask(s, i, m)]
             except BaseException as e:  # noqa: BLE001 — outcome convention
-                return [e]
+                out = [e]
             finally:
                 if tok is not None:
                     reset_ctx(tok)
+            return (out, None) if with_seqs else out
         with self._lock:
             if self._closed:
                 raise RuntimeError("AskBatcher is closed")
@@ -424,6 +994,8 @@ class AskBatcher:
         # (the submit path's max_batch cap, applied here without futures)
         for lo in range(0, len(batch), self.max_batch):
             sub = batch[lo:lo + self.max_batch]
+            with self._lock:
+                self._executing += 1
             try:
                 with region._ask_lock:
                     execute_ask_batch(region, sub)
@@ -431,6 +1003,14 @@ class AskBatcher:
                 for a in sub:
                     if a.outcome is None:
                         a.outcome = e
+            finally:
+                with self._lock:
+                    self._executing -= 1
+                    if self._executing == 0:
+                        # idle transition: wake the dispatcher so a solo
+                        # submit that arrived mid-wave closes now instead
+                        # of eating the rest of its adaptive window
+                        self._work.set()
             with self._lock:
                 self._batches += 1
                 self._asks += len(sub)
@@ -443,12 +1023,101 @@ class AskBatcher:
                 # columnar waves never wait for a window to close: the
                 # whole wave arrived at once, so its wait is dispatch lag
                 self._h_wait.observe((time.perf_counter() - t0) * 1e6)
-        return [a.outcome for a in batch]
+        outcomes = [a.outcome for a in batch]
+        return (outcomes, None) if with_seqs else outcomes
+
+    def ask_many_async(self, requests: Sequence[Any],
+                       ctxs: Optional[Sequence[Any]] = None,
+                       on_done: Optional[Callable[
+                           [List[Any], List[int]], Any]] = None) -> None:
+        """Continuous-mode async wave entry (ISSUE 16): stage the wave
+        NOW on the calling thread (preserving per-connection submit
+        order — staging order IS the linearization order) and return
+        immediately; `on_done(outcomes, seqs)` fires on the scheduler
+        thread at the LAST chunk's resolve boundary, with both lists
+        aligned to `requests` (seqs are the global resolve ordinals, 0
+        for failed members). This is what lets the gateway resolve
+        window N while the aggregator decodes and admission-charges
+        window N+1."""
+        if self._sched is None:
+            raise RuntimeError("ask_many_async requires continuous=True")
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("AskBatcher is closed")
+        reqs = list(requests)
+        batch = [BatchAsk(int(s), int(i), m, self.steps,
+                          self.max_extra_steps) for s, i, m in reqs]
+        if ctxs is not None:
+            for a, c in zip(batch, ctxs):
+                a.trace = c
+        if not batch:
+            if on_done is not None:
+                on_done([], [])
+            return
+        chunks = [batch[lo:lo + self.max_batch]
+                  for lo in range(0, len(batch), self.max_batch)]
+        state = {"left": len(chunks)}
+        state_lock = threading.Lock()
+
+        def _chunk_done(_h) -> None:
+            with state_lock:
+                state["left"] -= 1
+                last = state["left"] == 0
+            if last and on_done is not None:
+                on_done([a.outcome for a in batch],
+                        [a.resolve_seq for a in batch])
+
+        for c in chunks:
+            self._submit_wave(c, on_resolve=_chunk_done)
+
+    def _submit_wave(self, sub: List[BatchAsk], on_resolve=None):
+        """Stage one wave on the continuous scheduler with the batcher's
+        stats/histograms recorded at ITS resolve boundary (the engine
+        paths record after their synchronous run; here the wave is still
+        in flight when submit returns)."""
+        t0 = time.perf_counter()
+
+        def _done(h) -> None:
+            with self._lock:
+                self._batches += 1
+                self._asks += len(sub)
+                self._max_seen = max(self._max_seen, len(sub))
+                if len(sub) > 1:
+                    self._multi += 1
+            if self._h_size is not None:
+                self._h_size.observe(float(len(sub)))
+            if self._h_wait is not None:
+                self._h_wait.observe((time.perf_counter() - t0) * 1e6)
+            if on_resolve is not None:
+                on_resolve(h)
+
+        return self._sched.submit_wave(sub, on_resolve=_done)
 
     # ---------------------------------------------------------- dispatcher
     def _full(self) -> bool:
         with self._lock:
             return len(self._pending) >= self.max_batch
+
+    def idle(self) -> bool:
+        """Downstream idleness: nothing is executing below the window.
+        Public because the ingest aggregator folds it into ITS
+        window-close predicate."""
+        if self._sched is not None:
+            return self._sched.idle()
+        with self._lock:
+            return self._executing == 0
+
+    def _solo_idle(self) -> bool:
+        """The solo-latency fast-close predicate (ISSUE 16 satellite):
+        exactly ONE ask is pending AND nothing is executing downstream,
+        so nothing could possibly coalesce with it — close immediately.
+        Two or more pending asks ARE concurrency (and downstream
+        idleness flickers true between waves), so under load the
+        adaptive wait behaves exactly as before."""
+        with self._lock:
+            if len(self._pending) > 1:
+                return False
+        return self.idle()
 
     def _loop(self) -> None:
         while True:
@@ -462,17 +1131,59 @@ class AskBatcher:
                     if not self._pending:
                         break
                 # adaptive window: wait for the batch to fill, close on
-                # max_batch pending or window_s elapsed, whichever first
-                wait_adaptive_close(self._work, self.window_s, self._full)
+                # max_batch pending, window_s elapsed, or the pipeline
+                # going idle (solo fast-close) — whichever first
+                wait_adaptive_close(self._work, self.window_s, self._full,
+                                    idle=self._solo_idle)
+                if self._sched is not None:
+                    # wave-slot admission BEFORE the window closes: while
+                    # this thread waits for one of the `pipeline_depth`
+                    # in-flight waves to free a slot, late arrivals keep
+                    # joining the still-open window instead of eating a
+                    # whole extra wave cycle — the window closes as late
+                    # as the pipeline allows
+                    while not self._inflight_sem.acquire(timeout=0.25):
+                        with self._lock:
+                            closed = self._closed
+                        if closed:
+                            self._fail_pending(
+                                RuntimeError("AskBatcher is closed"))
+                            return
                 with self._lock:
                     close_batch = self._pending[:self.max_batch]
                     del self._pending[:self.max_batch]
                 if close_batch:
                     self._run_batch(close_batch)
+                elif self._sched is not None:
+                    self._inflight_sem.release()
 
     def _run_batch(self, close_batch: List[BatchAsk]) -> None:
         t_close = time.perf_counter()
+        if self._h_wait is not None:
+            for a in close_batch:
+                self._h_wait.observe((t_close - a.t_submit) * 1e6)
+        if self._sched is not None:
+            # continuous: stage and move on — the dispatcher is free to
+            # close the NEXT window while this wave's rounds run. The
+            # scheduler sets the futures at the resolve boundary; the
+            # wave slot (pipeline_depth semaphore) was acquired by the
+            # dispatcher loop BEFORE the window closed, so a submit
+            # storm cannot outrun the promise pool unboundedly.
+
+            def _release(_h) -> None:
+                self._inflight_sem.release()
+
+            try:
+                self._submit_wave(close_batch, on_resolve=_release)
+            except BaseException as e:  # noqa: BLE001 — never hang waiters
+                self._inflight_sem.release()
+                for a in close_batch:
+                    if a.future is not None and not a.future.done():
+                        a.future.set_exception(e)
+            return
         region = self.region
+        with self._lock:
+            self._executing += 1
         try:
             with region._ask_lock:
                 execute_ask_batch(region, close_batch)
@@ -480,6 +1191,11 @@ class AskBatcher:
             for a in close_batch:
                 if a.outcome is None:
                     a.outcome = e
+        finally:
+            with self._lock:
+                self._executing -= 1
+                if self._executing == 0:
+                    self._work.set()
         with self._lock:
             self._batches += 1
             self._asks += len(close_batch)
@@ -489,8 +1205,6 @@ class AskBatcher:
         if self._h_size is not None:
             self._h_size.observe(float(len(close_batch)))
         for a in close_batch:
-            if self._h_wait is not None:
-                self._h_wait.observe((t_close - a.t_submit) * 1e6)
             if isinstance(a.outcome, BaseException):
                 a.future.set_exception(a.outcome)
             else:
@@ -504,6 +1218,16 @@ class AskBatcher:
             if a.future is not None and not a.future.done():
                 a.future.set_exception(exc)
 
+    def quiesce(self, timeout: float = 30.0) -> bool:
+        """Block until no wave is in flight (continuous mode; serialized
+        engine calls are synchronous, so there is nothing to wait on).
+        Consistency reads (`sum_all`, conserved-value probes) call this
+        so they never observe a half-resolved wave's device state as
+        final."""
+        if self._sched is not None:
+            return self._sched.quiesce(timeout)
+        return True
+
     def close(self, timeout: float = 10.0) -> None:
         with self._lock:
             self._closed = True
@@ -511,6 +1235,8 @@ class AskBatcher:
         self._work.set()
         if t is not None:
             t.join(timeout)
+        if self._sched is not None:
+            self._sched.close(timeout)
         self._fail_pending(RuntimeError("AskBatcher is closed"))
 
     # ---------------------------------------------------------------- stats
@@ -518,15 +1244,28 @@ class AskBatcher:
         """Numeric summary (registry-collector compatible)."""
         with self._lock:
             b, n = self._batches, self._asks
-            return {"batches": float(b), "asks": float(n),
-                    "mean_batch_size": (n / b) if b else 0.0,
-                    "max_batch_size": float(self._max_seen),
-                    "multi_ask_batches": float(self._multi),
-                    "pending": float(len(self._pending)),
-                    # the engine's wave counter (ISSUE 12): every
-                    # execute_ask_batch invocation is one wave, and this
-                    # is the id the newest wave's spans carry — the
-                    # cross-check key between the trace timeline and
-                    # these stats
-                    "last_wave_id": float(
-                        getattr(self.region, "_wave_seq", 0))}
+            out = {"batches": float(b), "asks": float(n),
+                   "mean_batch_size": (n / b) if b else 0.0,
+                   "max_batch_size": float(self._max_seen),
+                   "multi_ask_batches": float(self._multi),
+                   "pending": float(len(self._pending)),
+                   # the engine's wave counter (ISSUE 12): every
+                   # execute_ask_batch invocation is one wave, and this
+                   # is the id the newest wave's spans carry — the
+                   # cross-check key between the trace timeline and
+                   # these stats
+                   "last_wave_id": float(
+                       getattr(self.region, "_wave_seq", 0))}
+        # overlap evidence (ISSUE 16 satellite): fraction of wave-busy
+        # wall time with >= 2 waves open on the bridge. Serialized mode
+        # reports 0.0 by construction — the A/B artifact's fingerprint.
+        if self._sched is not None:
+            sst = self._sched.stats()
+            out["overlap_ratio"] = sst["overlap_ratio"]
+            out["waves_overlap_s"] = sst["overlap_s"]
+            out["waves_busy_s"] = sst["busy_s"]
+        else:
+            out["overlap_ratio"] = 0.0
+            out["waves_overlap_s"] = 0.0
+            out["waves_busy_s"] = 0.0
+        return out

@@ -1,0 +1,332 @@
+"""Continuous wave formation (ISSUE 16 tentpole): the escape hatch's
+bit-parity with the serialized serve path, per-entity linearization and
+conserved totals with duplicate entities SPANNING concurrently open
+waves, mid-overlap per-ask timeout retiring only its own slot, the
+resolve-boundary ordering contracts (entity-journal commit-before-ack,
+seq-filtered replica publishes), the overlap stats surface, phase spans,
+and the `wait_adaptive_close` idle fast-close pinning solo latency.
+
+Tier-1 budget: every region here is the warm 2 shards x 16 entities x
+1 virtual device x payload-width-4 shape (same jit cache entries as
+tests/test_ask_batch.py) and waves stay <= 64 rows.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from akka_tpu.event.tracing import Tracer
+from akka_tpu.gateway import (AdmissionController, GatewayServer,
+                              RegionBackend, SloTracker, counter_behavior)
+from akka_tpu.gateway.aggregator import IngestAggregator
+from akka_tpu.gateway.ingress import encode_body
+from akka_tpu.gateway.replica import ReadReplicaCache
+from akka_tpu.sharding import AskBatcher
+from akka_tpu.sharding.device import DeviceEntity, DeviceShardRegion
+
+_REGIONS = {}
+
+
+def _region(tag):
+    """One tiny region per tag, all the SAME compiled shape."""
+    if tag not in _REGIONS:
+        spec = DeviceEntity(f"cw-{tag}", counter_behavior(4), n_shards=2,
+                            entities_per_shard=16, n_devices=1,
+                            payload_width=4)
+        _REGIONS[tag] = DeviceShardRegion(spec)
+    return _REGIONS[tag]
+
+
+def _total(region, entity_id):
+    ref = region.entity_ref(entity_id)
+    return float(np.asarray(
+        region.system.read_state("total", np.asarray([ref.row],
+                                                     np.int32)))[0])
+
+
+def _server(region, adm_rate=1e9, **backend_kw):
+    backend = RegionBackend(region, max_batch=16, **backend_kw)
+    srv = GatewayServer(None, backend,
+                        AdmissionController(rate=adm_rate, burst=adm_rate),
+                        SloTracker())
+    return srv, backend
+
+
+# ------------------------------------------------------------ escape hatch
+def test_continuous_off_is_bit_identical_to_serialized():
+    """`continuous=False` (explicit AND the default) serves byte-for-byte
+    what the serialized path serves, and `continuous=True` lands the
+    identical reply bytes on a sequential workload — the overlap changes
+    WHEN waves run, never what a reply says."""
+    def run(tag, **kw):
+        srv, backend = _server(_region(tag), **kw)
+        replies = []
+        try:
+            for i in range(20):
+                ent = f"par-{i % 4}"
+                op = "get" if i % 5 == 4 else "add"
+                body = encode_body({"id": i, "tenant": "t0", "entity": ent,
+                                    "op": op, "value": float(i % 3 + 1)})
+                replies.append(bytes(srv.handle_frame(body)))
+            totals = {f"par-{k}": _total(_region(tag), f"par-{k}")
+                      for k in range(4)}
+        finally:
+            backend.close()
+        return replies, totals
+
+    default_replies, default_totals = run("par-default")
+    off_replies, off_totals = run("par-off", continuous=False)
+    on_replies, on_totals = run("par-on", continuous=True,
+                                pipeline_depth=4)
+    assert off_replies == default_replies  # flag plumbing is inert
+    assert on_replies == default_replies   # overlap never edits a reply
+    assert off_totals == default_totals == on_totals
+    # the hatch really is a hatch: no scheduler exists when off
+    assert RegionBackend(_region("par-off")).batcher._sched is None
+
+
+# --------------------------------------------- overlap + conserved totals
+def test_continuous_concurrent_waves_linearized_and_conserved():
+    """Duplicate entities spanning concurrently OPEN waves: every ack is
+    a distinct prefix sum of that entity's sent values (the one-in-flight
+    ask-per-destination-row rule extended across waves) and the region
+    total is exactly the sent sum. Overlap stats prove waves actually
+    coexisted on the bridge."""
+    region = _region("conc")
+    srv, backend = _server(region, continuous=True, pipeline_depth=4)
+    ents = [f"ln-{k}" for k in range(6)]
+    sent = {e: [] for e in ents}
+    acks = {e: [] for e in ents}
+    errs = []
+    lock = threading.Lock()
+
+    def worker(w):
+        for i in range(8):
+            ent = ents[(w + i) % len(ents)]  # every entity hit by many
+            val = float(w * 8 + i + 1)       # threads' concurrent waves
+            body = encode_body({"id": w * 100 + i, "tenant": f"t{w % 2}",
+                                "entity": ent, "op": "add", "value": val})
+            rep = json.loads(srv.handle_frame(body))
+            with lock:
+                if rep.get("status") != "ok":
+                    errs.append(rep)
+                else:
+                    sent[ent].append(val)
+                    acks[ent].append(float(rep["value"]))
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(8)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs[:3]
+        # deterministic overlap: two async waves staged back to back are
+        # both OPEN until their device rounds retire, so the overlap
+        # clock must accrue even if the dispatcher coalesced the whole
+        # threaded burst above into non-overlapping big waves
+        r0, r1 = region.entity_ref(ents[0]), region.entity_ref(ents[1])
+        nudged = threading.Event()
+        backend.batcher.ask_many_async([(r0.shard, r0.index, [0.0])])
+        backend.batcher.ask_many_async(
+            [(r1.shard, r1.index, [0.0])],
+            on_done=lambda _o, _s: nudged.set())
+        assert nudged.wait(30.0)
+        grand = backend.sum_all()
+        stats = backend.batcher.stats()
+    finally:
+        backend.close()
+    for e in ents:
+        chain = sorted(acks[e])
+        diffs = [chain[0]] + [b - a for a, b in zip(chain, chain[1:])]
+        assert sorted(diffs) == sorted(sent[e])  # prefix sums of SOME order
+        assert chain[-1] == sum(sent[e]) == _total(region, e)
+    assert grand == sum(sum(v) for v in sent.values())
+    # satellite 2: the overlap surface exists and measured real overlap
+    # (strictly positive, not a fixed fraction — the dispatcher's
+    # late-window-close policy coalesces this small workload into few
+    # big waves, so how MUCH wall time has two waves open is timing-
+    # dependent; the 64-client bench leg is where the ratio is sized)
+    assert {"overlap_ratio", "waves_overlap_s",
+            "waves_busy_s"} <= set(stats)
+    assert stats["overlap_ratio"] > 0.0
+    # the serialized collector reports the same keys, pinned to zero
+    sb = RegionBackend(region, max_batch=16)
+    try:
+        sb.ask("ln-0", 0.0)
+        assert sb.batcher.stats()["overlap_ratio"] == 0.0
+    finally:
+        sb.close()
+
+
+# ------------------------------------------------------- mid-overlap fail
+def test_mid_overlap_timeout_retires_only_its_slot():
+    """An ask to a never-spawned row times out inside an OPEN wave while
+    other waves overlap it: the timeout retires ITS promise slot with the
+    serialized engine's exact message, wave-mates and concurrent waves
+    resolve correctly."""
+    region = _region("conc")
+    batcher = AskBatcher(region, max_batch=16, steps=2, max_extra_steps=2,
+                         continuous=True, pipeline_depth=4)
+    ref = region.entity_ref("to-live")
+    dead_idx = region.eps - 1  # never handed out by entity_ref here
+    with region._lock:
+        assert dead_idx >= region._spawned[ref.shard]  # truly dead row
+    before = region.ask_pool_stats()
+    noise_refs = [region.entity_ref(f"to-n{i}") for i in range(3)]
+    noise_out = []
+
+    def noise():  # concurrent waves keep the scheduler overlapped
+        noise_out.append(batcher.ask_many(
+            [(r.shard, r.index, [1.0]) for r in noise_refs]))
+
+    th = threading.Thread(target=noise)
+    try:
+        th.start()
+        out = batcher.ask_many([(ref.shard, ref.index, [5.0]),
+                                (ref.shard, dead_idx, [1.0])])
+        th.join()
+    finally:
+        batcher.close()
+    assert float(np.asarray(out[0])[0]) == 5.0
+    assert isinstance(out[1], TimeoutError)
+    assert "unanswered after 4 steps" in str(out[1])
+    for r in noise_out[0]:
+        assert float(np.asarray(r)[0]) == 1.0
+    after = region.ask_pool_stats()
+    assert after["retired"] == before["retired"] + 1
+    # the pool still serves after the retirement
+    assert float(np.asarray(
+        region.ask(ref.shard, ref.index, [1.0]))[0]) == 6.0
+
+
+# --------------------------------------------- resolve-boundary contracts
+def test_resolve_boundary_journal_and_replica_publish_order(tmp_path):
+    """The per-wave resolve boundary keeps BOTH PR 15's commit-before-ack
+    (every acked add is in the entity journal by ack time) and PR 14's
+    replica freshness (publishes filtered per entity by resolve ordinal,
+    so a slow wave never overwrites a younger wave's total)."""
+    spec = DeviceEntity("cw-jrn", counter_behavior(4), n_shards=2,
+                        entities_per_shard=16, n_devices=1, payload_width=4)
+    region = DeviceShardRegion(spec)
+    region.attach_journal(str(tmp_path))
+    ej = region.attach_entity_journal(fsync_every_n=1)
+    cache = ReadReplicaCache(lambda: 0, hot_hits=1, max_step_lag=1 << 30)
+    backend = RegionBackend(region, max_batch=16, continuous=True,
+                            pipeline_depth=4)
+    srv = GatewayServer(None, backend,
+                        AdmissionController(rate=1e9, burst=1e9),
+                        SloTracker(), replica_cache=cache)
+    ents = [f"jr-{k}" for k in range(3)]
+    sent = {e: 0.0 for e in ents}
+    lock = threading.Lock()
+
+    def worker(w):
+        for i in range(6):
+            ent = ents[(w + i) % len(ents)]
+            val = float(w * 6 + i + 1)
+            body = encode_body({"id": w * 100 + i, "tenant": "t0",
+                                "entity": ent, "op": "add", "value": val})
+            rep = json.loads(srv.handle_frame(body))
+            assert rep["status"] == "ok", rep
+            with lock:
+                sent[ent] += val
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(6)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        backend.batcher.quiesce()
+        # commit-before-ack: with every ack delivered, the journal fold
+        # IS the acked frontier — exactly the sent sums
+        assert ej.totals() == pytest.approx(sent)
+        # publish ordering: the replica's total per entity is the LAST
+        # resolve's authoritative total, never a slower wave's stale one
+        for e in ents:
+            got = cache.try_read(e)
+            assert got is not None and got[0] == sent[e] == _total(region, e)
+        # the filter itself: a publish with an older resolve ordinal is
+        # dropped per entity, a newer one lands
+        srv._publish_filtered({"jr-x": 9.0}, {"jr-x": 50})
+        srv._publish_filtered({"jr-x": 1.0, "jr-y": 2.0},
+                              {"jr-x": 40, "jr-y": 41})
+        assert cache.try_read("jr-x")[0] == 9.0  # stale wave dropped
+        assert cache.try_read("jr-y")[0] == 2.0  # fresh entity landed
+    finally:
+        backend.close()
+        region.detach_entity_journal()
+
+
+# ------------------------------------------------------------- phase spans
+def test_wave_phase_spans_cover_the_wave():
+    """Satellite 2: every ask.wave now has wave.stage /
+    wave.inflight_wait / wave.resolve children carrying the wave's id,
+    tiling the wave span (stage ends before resolve begins)."""
+    from akka_tpu.serialization import frames
+    tr = Tracer(sample_rate=1.0, seed=7)
+    region = _region("conc")
+    backend = RegionBackend(region, max_batch=16)
+    srv = GatewayServer(None, backend,
+                        AdmissionController(rate=1e9, burst=1e9),
+                        SloTracker(), tracer=tr)
+    try:
+        body = frames.encode_request_batch(
+            [1, 2], ["t0"] * 2, ["sp-a", "sp-b"],
+            [frames.OP_ADD] * 2, [1.0, 2.0])
+        reps = frames.decode_replies(srv.handle_frame(body))
+        assert [r["status"] for r in reps] == ["ok"] * 2
+    finally:
+        backend.close()
+    spans = tr.spans()
+    wave = next(s for s in spans if s["name"] == "ask.wave")
+    phases = {s["name"]: s for s in spans
+              if s["name"] in ("wave.stage", "wave.inflight_wait",
+                               "wave.resolve")}
+    assert set(phases) == {"wave.stage", "wave.inflight_wait",
+                           "wave.resolve"}
+    for s in phases.values():
+        assert s["wave_id"] == wave["wave_id"]
+        assert s["t0"] >= wave["t0"] and s["t1"] <= wave["t1"]
+    assert phases["wave.stage"]["t1"] <= phases["wave.resolve"]["t0"]
+
+
+# ---------------------------------------------------------- idle fast-close
+def test_idle_fast_close_pins_solo_latency():
+    """Satellite 1 regression pin: with the whole pipeline idle a lone
+    frame's window closes IMMEDIATELY instead of eating the adaptive
+    deadline — solo p50 stays far under a deliberately huge window_s, in
+    both continuous and serialized modes."""
+    region = _region("conc")
+    for continuous in (True, False):
+        srv, backend = _server(region, continuous=continuous)
+        agg = IngestAggregator(srv, max_window=64, window_s=0.25)
+        lats = []
+        try:
+            for i in range(3):
+                body = encode_body({"id": i, "tenant": "t0",
+                                    "entity": "fc-0", "op": "add",
+                                    "value": 1.0})
+                t0 = time.perf_counter()
+                rep = json.loads(agg.submit(body).result(timeout=10.0))
+                lats.append(time.perf_counter() - t0)
+                assert rep["status"] == "ok", rep
+        finally:
+            agg.close()
+            backend.close()
+        lats.sort()
+        assert lats[len(lats) // 2] < 0.1, (continuous, lats)
+
+
+# ------------------------------------------------------------ budget guard
+def test_budget_guard_regions_stay_tiny():
+    for region in _REGIONS.values():
+        assert region.spec.n_shards <= 2
+        assert region.eps <= 16
+        assert region.system.capacity <= 64

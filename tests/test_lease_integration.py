@@ -265,6 +265,10 @@ def test_singleton_waits_for_lease():
         InProcLease.reset_all()
 
 
+@pytest.mark.slow  # 21s (3 systems + partition + release window): demoted
+# in PR 16 to pay for tests/test_continuous_wave.py; the tier-1 twin is
+# test_cluster.py::test_lease_mutual_exclusion_and_expiry (release/expiry
+# mechanics) — the full SBR-path sibling above is already slow-tier
 def test_sbr_releases_lease_after_resolution(lease_cluster):
     """Regression (r3 review): the winning decider must RELEASE the SBR
     lease after the resolution settles, or the next partition's healthy

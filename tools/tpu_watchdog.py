@@ -518,6 +518,28 @@ def on_tpu_found(detail: str) -> None:
                                 da.get("group_commit_proof"),
                             "per_event_vs_wave":
                                 da.get("per_event_vs_wave")})
+            ca = gw.get("continuous_ab", {})
+            if ca:
+                # continuous wave formation (ISSUE 16): serialized vs
+                # continuous waves at 1/8/64 clients, equal admission;
+                # acceptance is authoritative p99 at 64 clients <= 0.1x
+                # the serialized leg's with totals conserved and real
+                # measured overlap on the bridge
+                append_log({"ts": _utcnow(),
+                            "ok": bool(ca.get("ok")) and
+                                  bool(ca.get("equal_admission")),
+                            "detail": "continuous wave formation "
+                                      "(64 clients, equal admission)",
+                            "p99_ratio_64": ca.get("p99_ratio_64"),
+                            "p99_serialized_64_ms":
+                                ca.get("p99_serialized_64_ms"),
+                            "p99_continuous_64_ms":
+                                ca.get("p99_continuous_64_ms"),
+                            "overlap_ratio_64":
+                                ca.get("overlap_ratio_64"),
+                            "continuous_speedup_64":
+                                ca.get("speedup_64"),
+                            "conserved": ca.get("conserved")})
     # wire-decode throughput: batch np.frombuffer vs json.loads, plus the
     # full-path 1/8/64-client encoding sweep (docs/SERVING_GATEWAY.md
     # wire-protocol section)

@@ -53,7 +53,8 @@ _DURATION_FIELDS = {
 }
 
 _WAVE_NAMES = ("ask.wave", "wave.latch_reset", "wave.flush",
-               "wave.step_round", "wave.readback")
+               "wave.step_round", "wave.readback", "wave.stage",
+               "wave.inflight_wait", "wave.resolve", "wave.journal")
 
 PID_GATEWAY = 1
 PID_RUNTIME = 2
@@ -92,13 +93,52 @@ def wall_mono_offset(spans: Sequence[Dict[str, Any]],
     return statistics.median(deltas) if deltas else None
 
 
+def _wave_lanes(spans: Sequence[Dict[str, Any]]) -> Dict[int, int]:
+    """wave_id -> track lane for wave-scoped spans. Serialized waves
+    never overlap (the ask lock), so every wave lands on lane 0 — the
+    historical single "ask waves" row. Continuous waves (ISSUE 16)
+    overlap in wall time; interval-greedy lane assignment keeps each
+    overlapping wave on its own row so complete events still stack-nest
+    per track."""
+    iv: Dict[int, List[float]] = {}
+    for s in spans:
+        if s.get("name") not in _WAVE_NAMES:
+            continue
+        wid = s.get("wave_id")
+        if not isinstance(wid, int):
+            continue
+        t0, t1 = float(s.get("t0", 0.0)), float(s.get("t1", 0.0))
+        cur = iv.get(wid)
+        if cur is None:
+            iv[wid] = [t0, t1]
+        else:
+            cur[0] = min(cur[0], t0)
+            cur[1] = max(cur[1], t1)
+    lanes: Dict[int, int] = {}
+    lane_end: List[float] = []
+    for wid, (t0, t1) in sorted(iv.items(), key=lambda kv: kv[1][0]):
+        for k, end in enumerate(lane_end):
+            if t0 >= end - 1e-9:
+                lanes[wid] = k
+                lane_end[k] = t1
+                break
+        else:
+            lanes[wid] = len(lane_end)
+            lane_end.append(t1)
+    return lanes
+
+
 def _span_events(spans: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
     out: List[Dict[str, Any]] = []
     tids: Dict[int, int] = {}
+    lanes = _wave_lanes(spans)
     for s in spans:
         trace = int(s.get("trace", 0))
         if s.get("name") in _WAVE_NAMES:
-            tid = TID_WAVES
+            # lane 0 is TID_WAVES; overlapping continuous waves spill to
+            # negative tids so they can never collide with request rows
+            lane = lanes.get(s.get("wave_id"), 0)
+            tid = TID_WAVES if lane == 0 else -lane
         else:
             tid = tids.setdefault(trace, len(tids) + 1)
         args = {k: v for k, v in s.items()
@@ -158,6 +198,12 @@ def _metadata(span_events, fr_events) -> List[Dict[str, Any]]:
     named = set()
     for ev in span_events:
         tid = ev["tid"]
+        if tid < 0 and tid not in named:  # overflow wave lanes
+            named.add(tid)
+            meta.append({"name": "thread_name", "ph": "M",
+                         "pid": PID_GATEWAY, "tid": tid,
+                         "args": {"name": f"ask waves +{-tid}"}})
+            continue
         if tid != TID_WAVES and tid not in named:
             named.add(tid)
             trace = ev["args"].get("trace", "?")
