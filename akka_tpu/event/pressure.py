@@ -102,7 +102,8 @@ class PressureReader:
 
 
 def system_pressure_sources(system, ask_pool_stats: Optional[Callable[[], Dict[str, Any]]] = None,
-                            occupancy_quantile: float = 0.9) -> Dict[str, Callable[[], float]]:
+                            occupancy_quantile: float = 0.9,
+                            open_wave_depth: Optional[Callable[[], float]] = None) -> Dict[str, Callable[[], float]]:
     """Standard source dict for a (Sharded)BatchedSystem:
 
     | signal                 | source                                      |
@@ -111,11 +112,19 @@ def system_pressure_sources(system, ask_pool_stats: Optional[Callable[[], Dict[s
     | exchange_dropped       | attention-word dropped (cumulative)         |
     | ask_pool_occupancy     | promise-slot occupancy (level, 0..1)        |
     | mailbox_occupancy_p90  | metric-slab occupancy-lane p90 (level)      |
+    | open_wave_depth        | scheduler open waves / pipeline_depth       |
 
     `system` may be a live object whose `.system` is swapped under it by a
     re-shard (MeshSentinel, DeviceShardRegion): sources resolve attributes
     at poll time, never capture slabs. The histogram signal only appears
-    when the system compiles the metric slab in (`metrics_on`)."""
+    when the system compiles the metric slab in (`metrics_on`).
+
+    `open_wave_depth` (ISSUE 18 satellite) is the continuous-wave
+    pipeline's fullness, a LEVEL in 0..1: 1.0 means `pipeline_depth`
+    waves are already open and the next window will block on a wave
+    slot, so an admission threshold below 1.0 sheds BEFORE the promise
+    pool backs the whole ingest path up. Pass the scheduler's
+    `open_wave_depth` bound method (AskBatcher.open_wave_depth)."""
     sys_of = (lambda: system.system) if hasattr(system, "system") \
         else (lambda: system)
 
@@ -126,6 +135,9 @@ def system_pressure_sources(system, ask_pool_stats: Optional[Callable[[], Dict[s
     if ask_pool_stats is not None:
         sources["ask_pool_occupancy"] = \
             lambda: float(ask_pool_stats()["occupancy"])
+    if open_wave_depth is not None:
+        sources["open_wave_depth"] = \
+            lambda: float(open_wave_depth())
     if getattr(sys_of(), "metrics_on", False):
         from ..batched.metrics_slab import HIST_NAMES, bucket_percentile
 

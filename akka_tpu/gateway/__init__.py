@@ -3,6 +3,8 @@ SLOs out (ISSUE 8 tentpole; docs/SERVING_GATEWAY.md).
 
 Four planes, each its own module:
 - ingress:    framed-TCP front door + in-proc transport + RegionBackend
+- evloop:     selector event-loop transport (C1M front door: all sockets
+              on one thread, optional SO_REUSEPORT accept shards)
 - aggregator: cross-connection ingest windows (shared decode/admission/
               ask waves across sockets)
 - admission:  per-tenant token buckets + runtime-pressure load shedding
@@ -10,9 +12,10 @@ Four planes, each its own module:
 """
 
 from .admission import (AdmissionController, AskPoolExhausted, Reject,
-                        TokenBucket, handle_pressure_signals,
-                        region_pressure_signals)
+                        TokenBucket, VectorTenantTable,
+                        handle_pressure_signals, region_pressure_signals)
 from .aggregator import IngestAggregator
+from .evloop import EvLoopIngress
 from .ingress import (DEFAULT_MAX_FRAME, GatewayClient, GatewayServer,
                       RegionBackend, counter_behavior, encode_body,
                       encode_frame, FrameReader)
@@ -20,7 +23,8 @@ from .slo import SloTracker
 from ..serialization import frames
 
 __all__ = ["AdmissionController", "AskPoolExhausted", "Reject",
-           "TokenBucket", "handle_pressure_signals",
+           "TokenBucket", "VectorTenantTable", "EvLoopIngress",
+           "handle_pressure_signals",
            "region_pressure_signals", "GatewayClient", "GatewayServer",
            "IngestAggregator", "RegionBackend", "counter_behavior",
            "encode_body", "encode_frame", "FrameReader", "SloTracker",

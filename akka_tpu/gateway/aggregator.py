@@ -38,7 +38,8 @@ from concurrent.futures import Future
 from typing import Any, Dict, List, Optional
 
 from ..serialization import frames
-from ..sharding.ask_batch import wait_adaptive_close
+from ..sharding.ask_batch import (IDLE_WAIT_MAX, IDLE_WAIT_MIN,
+                                  wait_adaptive_close)
 
 __all__ = ["IngestAggregator"]
 
@@ -73,6 +74,7 @@ class IngestAggregator:
         self._continuous = bool(getattr(server, "continuous", False)) \
             and hasattr(server, "submit_frames")
         self._inflight = 0
+        self._idle_wakeups = 0
         self._depth_sem = threading.BoundedSemaphore(
             max(1, int(getattr(server, "pipeline_depth", 4))))
         self._pending: List[_PendingFrame] = []
@@ -157,9 +159,19 @@ class IngestAggregator:
         return batcher is None or batcher.idle()
 
     def _loop(self) -> None:
+        # exponential idle backoff, same policy as the ask-batch loops
+        # (ISSUE 18 satellite): 1 ms after work, doubling to 250 ms idle;
+        # submit's Event.set() re-arms tight polling instantly
+        idle_wait = IDLE_WAIT_MIN
         while True:
-            self._work.wait(0.25)
+            fired = self._work.wait(idle_wait)
             self._work.clear()
+            if fired:
+                idle_wait = IDLE_WAIT_MIN
+            else:
+                idle_wait = min(idle_wait * 2.0, IDLE_WAIT_MAX)
+                with self._lock:
+                    self._idle_wakeups += 1
             while True:
                 with self._lock:
                     if not self._pending:
@@ -314,4 +326,5 @@ class IngestAggregator:
                 "max_window_size": float(self._max_seen),
                 "multi_frame_windows": float(self._multi),
                 "pending": float(len(self._pending)),
+                "idle_wakeups": float(self._idle_wakeups),
             }

@@ -326,8 +326,11 @@ class RegionBackend:
         return float(np.asarray(vals, np.float64).sum())
 
     def pressure_signals(self) -> Dict[str, Callable[[], float]]:
+        # includes open_wave_depth when this backend batches asks
+        # (ISSUE 18 satellite): admission sheds on a full wave pipeline
+        # before the promise pool is the thing that says no
         from .admission import region_pressure_signals
-        return region_pressure_signals(self.region)
+        return region_pressure_signals(self.region, batcher=self.batcher)
 
 
 # -------------------------------------------------- mixed-encoding windows
@@ -388,7 +391,11 @@ class GatewayServer:
                  max_frame: int = DEFAULT_MAX_FRAME, registry=None,
                  tracer=None, aggregate: bool = False,
                  max_window: int = 64, window_wait_s: float = 150e-6,
-                 pipeline_depth: int = 4, replica_cache=None):
+                 pipeline_depth: int = 4, replica_cache=None,
+                 transport: str = "stream", accept_shards: int = 1):
+        if transport not in ("stream", "evloop"):
+            raise ValueError(f"unknown transport {transport!r} "
+                             "(expected 'stream' or 'evloop')")
         self.system = system
         self.backend = backend
         self.admission = admission
@@ -443,10 +450,20 @@ class GatewayServer:
             self._h_decode_ns = registry.histogram(
                 "gateway_decode_ns_per_frame",
                 "nanoseconds of wire decode per binary request record")
+        # C1M front door (ISSUE 18): transport picks who owns the
+        # sockets — "stream" materializes a per-connection stage graph
+        # (the A/B twin, bit-identical to the seed), "evloop" runs ALL
+        # sockets on selector loop threads (evloop.EvLoopIngress). Both
+        # funnel frames into the same serve path.
+        self.transport = transport
+        self.accept_shards = max(1, int(accept_shards))
+        self._evloop = None
         # cross-connection ingest windowing (ISSUE 13): off by default —
-        # the per-frame path below stays bit-identical to the seed
+        # the per-frame path below stays bit-identical to the seed. The
+        # evloop transport has no per-frame stage to fall back on, so it
+        # always gets the shared aggregator.
         self.aggregator = None
-        if aggregate:
+        if aggregate or transport == "evloop":
             from .aggregator import IngestAggregator
             self.aggregator = IngestAggregator(
                 self, max_window=max_window, window_s=window_wait_s,
@@ -456,6 +473,13 @@ class GatewayServer:
 
     # ------------------------------------------------------------ transport
     def start(self) -> Tuple[str, int]:
+        if self.transport == "evloop":
+            from .evloop import EvLoopIngress
+            self._evloop = EvLoopIngress(
+                self, host=self.host, port=self.port,
+                n_shards=self.accept_shards, registry=self._registry)
+            self.host, self.port = self._evloop.start()
+            return self.host, self.port
         from ..stream.dsl import Keep, Sink
         from ..stream.framing import Framing
         from ..stream.tcp import Tcp
@@ -490,6 +514,9 @@ class GatewayServer:
         return self.host, self.port
 
     def stop(self) -> None:
+        if self._evloop is not None:
+            self._evloop.stop()
+            self._evloop = None
         if self._binding is not None:
             self._binding.unbind()
             self._binding = None

@@ -168,6 +168,10 @@ class _SelectorLoop:
         self._stopped = threading.Event()
         self._waker_r, self._waker_w = socket.socketpair()
         self._waker_r.setblocking(False)
+        # the write end MUST be nonblocking too: when the pipe is full the
+        # loop is already awake, and a blocking send here can deadlock the
+        # selector thread against itself (cb -> _set_mask -> execute)
+        self._waker_w.setblocking(False)
         self.sel.register(self._waker_r, selectors.EVENT_READ, ("waker", None))
         self._thread = threading.Thread(target=self._run, name=name,
                                         daemon=True)

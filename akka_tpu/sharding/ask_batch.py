@@ -47,6 +47,13 @@ from ..event.tracing import NOOP_SPAN, current_ctx, reset_ctx, set_ctx
 __all__ = ["BatchAsk", "execute_ask_batch", "AskBatcher",
            "ContinuousWaveScheduler", "wait_adaptive_close"]
 
+# idle-poll backoff bounds for the dispatcher/runner loops (ISSUE 18
+# satellite): an idle loop parks IDLE_WAIT_MIN after its last work and
+# doubles up to IDLE_WAIT_MAX; submit's Event.set() re-arms tight polling
+# instantly, so the backoff trades idle CPU wakeups for nothing else
+IDLE_WAIT_MIN = 1e-3
+IDLE_WAIT_MAX = 0.25
+
 
 def wait_adaptive_close(work: threading.Event, window_s: float,
                         full, idle=None) -> None:
@@ -445,6 +452,11 @@ class ContinuousWaveScheduler:
         self._busy_s = 0.0
         self._overlap_s = 0.0
         self._waves_done = 0
+        # idle-wakeup accounting (ISSUE 18 satellite): the runner backs
+        # off exponentially while idle instead of spinning at a fixed
+        # 0.25 s poll — these count the empty wakeups that remain
+        self._idle_wakeups = 0
+        self._t_loop0: Optional[float] = None
         # idle-transition hook (wait_adaptive_close fast-close): callers
         # park on their own events; the scheduler pokes this when the
         # last open wave resolves
@@ -532,9 +544,23 @@ class ContinuousWaveScheduler:
 
     # -------------------------------------------------------------- runner
     def _loop(self) -> None:
+        # exponential idle backoff (ISSUE 18 satellite): park 1 ms after
+        # work, doubling to 250 ms while nothing arrives; `_work.set()`
+        # interrupts the wait instantly, so the re-arm to tight polling
+        # costs zero latency when work shows up
+        idle_wait = IDLE_WAIT_MIN
+        with self._lock:
+            if self._t_loop0 is None:
+                self._t_loop0 = time.monotonic()
         while True:
-            self._work.wait(0.25)
+            fired = self._work.wait(idle_wait)
             self._work.clear()
+            if fired:
+                idle_wait = IDLE_WAIT_MIN
+            else:
+                idle_wait = min(idle_wait * 2.0, IDLE_WAIT_MAX)
+                with self._lock:
+                    self._idle_wakeups += 1
             while True:
                 region = self.region
                 with region._ask_lock:
@@ -754,10 +780,23 @@ class ContinuousWaveScheduler:
         serialized one-wave-at-a-time schedule."""
         with self._lock:
             busy, over = self._busy_s, self._overlap_s
+            up = (time.monotonic() - self._t_loop0) \
+                if self._t_loop0 is not None else 0.0
             return {"open_waves": float(self._open),
                     "waves_resolved": float(self._waves_done),
                     "busy_s": busy, "overlap_s": over,
-                    "overlap_ratio": (over / busy) if busy > 0 else 0.0}
+                    "overlap_ratio": (over / busy) if busy > 0 else 0.0,
+                    "idle_wakeups": float(self._idle_wakeups),
+                    "idle_wakeups_per_s":
+                        (self._idle_wakeups / up) if up > 0 else 0.0}
+
+    def open_wave_depth(self) -> float:
+        """Open waves over pipeline depth, 0..1+ (ISSUE 18 satellite):
+        the pressure form of the promise-pool headroom — 1.0 means the
+        wave pipeline is full and the next window will block on a slot,
+        so admission should start shedding BEFORE the pool drains."""
+        with self._lock:
+            return self._open / self.depth
 
     # ----------------------------------------------------------- lifecycle
     def close(self, timeout: float = 10.0) -> None:
@@ -843,6 +882,8 @@ class AskBatcher:
         self._asks = 0
         self._multi = 0
         self._max_seen = 0
+        self._idle_wakeups = 0
+        self._t_loop0: Optional[float] = None
         self._h_size = self._h_wait = None
         if registry is not None:
             self._h_size = registry.histogram(
@@ -1107,6 +1148,17 @@ class AskBatcher:
         with self._lock:
             return self._executing == 0
 
+    def open_wave_depth(self) -> float:
+        """Pressure form of wave-pipeline fullness, 0..1+ (ISSUE 18
+        satellite): continuous mode reports the scheduler's open waves
+        over `pipeline_depth`; the serialized engine reports in-flight
+        engine calls over the same depth (0 or 1/depth — it can never
+        pipeline)."""
+        if self._sched is not None:
+            return self._sched.open_wave_depth()
+        with self._lock:
+            return self._executing / self.pipeline_depth
+
     def _solo_idle(self) -> bool:
         """The solo-latency fast-close predicate (ISSUE 16 satellite):
         exactly ONE ask is pending AND nothing is executing downstream,
@@ -1120,9 +1172,19 @@ class AskBatcher:
         return self.idle()
 
     def _loop(self) -> None:
+        idle_wait = IDLE_WAIT_MIN  # exponential idle backoff (ISSUE 18)
+        with self._lock:
+            if self._t_loop0 is None:
+                self._t_loop0 = time.monotonic()
         while True:
-            self._work.wait(0.25)
+            fired = self._work.wait(idle_wait)
             self._work.clear()
+            if fired:
+                idle_wait = IDLE_WAIT_MIN
+            else:
+                idle_wait = min(idle_wait * 2.0, IDLE_WAIT_MAX)
+                with self._lock:
+                    self._idle_wakeups += 1
             if self._closed:
                 self._fail_pending(RuntimeError("AskBatcher is closed"))
                 return
@@ -1244,7 +1306,15 @@ class AskBatcher:
         """Numeric summary (registry-collector compatible)."""
         with self._lock:
             b, n = self._batches, self._asks
+            up = (time.monotonic() - self._t_loop0) \
+                if self._t_loop0 is not None else 0.0
+            idle = self._idle_wakeups
             out = {"batches": float(b), "asks": float(n),
+                   # idle-backoff evidence (ISSUE 18 satellite): empty
+                   # dispatcher wakeups and their rate — bounded by
+                   # 1/IDLE_WAIT_MAX (= 4/s) once the backoff saturates
+                   "idle_wakeups": float(idle),
+                   "idle_wakeups_per_s": (idle / up) if up > 0 else 0.0,
                    "mean_batch_size": (n / b) if b else 0.0,
                    "max_batch_size": float(self._max_seen),
                    "multi_ask_batches": float(self._multi),
@@ -1264,8 +1334,14 @@ class AskBatcher:
             out["overlap_ratio"] = sst["overlap_ratio"]
             out["waves_overlap_s"] = sst["overlap_s"]
             out["waves_busy_s"] = sst["busy_s"]
+            out["runner_idle_wakeups"] = sst["idle_wakeups"]
+            out["runner_idle_wakeups_per_s"] = sst["idle_wakeups_per_s"]
+            out["open_wave_depth"] = self._sched.open_wave_depth()
         else:
             out["overlap_ratio"] = 0.0
             out["waves_overlap_s"] = 0.0
             out["waves_busy_s"] = 0.0
+            out["runner_idle_wakeups"] = 0.0
+            out["runner_idle_wakeups_per_s"] = 0.0
+            out["open_wave_depth"] = 0.0
         return out

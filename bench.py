@@ -1960,6 +1960,178 @@ def bench_gateway_continuous_ab(region, per_leg: int = 384):
                            for r in serialized + continuous))}
 
 
+def bench_c1m_frontdoor(n_conns: int = 256, n_tenants: int = 20000,
+                        per_conn: int = 16):
+    """c1m-frontdoor: the C1M front-door transport A/B (ISSUE 18) — the
+    SAME pipelined JSON traffic over real TCP against the two gateway
+    transports:
+
+    - stream: the per-connection stage-graph path (a thread-backed
+      pipeline materialized per accepted socket), aggregate=True so both
+      legs ride the shared ingest aggregator.
+    - evloop: the selector event-loop ingress — ALL sockets on one loop
+      thread, frames straight into the same aggregator.
+
+    The traffic is backend-free echo (an unknown op draws a typed error
+    AFTER the admission charge), so the measurement isolates the front
+    door: accept, frame reassembly, vectorized tenant admission over
+    `n_tenants` distinct tenants (the columnar VectorTenantTable), serve
+    windowing, reply write-back. The client is its own single-thread
+    selector pump driving `n_conns` nonblocking sockets with
+    pre-encoded request blobs — identical bytes both legs, so admission
+    counters must come back identical (equal_admission).
+
+    Connection counts are clamped to the process FD budget: both ends
+    of every socket live in THIS process, so the ceiling is
+    (RLIMIT_NOFILE soft - slack) / 2 — published as the max-connections
+    datum next to the throughput rows. Acceptance: evloop req/s >= 2x
+    stream at equal admission."""
+    import resource
+    import selectors as _selectors
+    import socket as _socket
+
+    from akka_tpu import ActorSystem
+    from akka_tpu.gateway import (AdmissionController, GatewayServer,
+                                  SloTracker)
+    from akka_tpu.gateway.ingress import FrameReader, encode_frame
+
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    slack = 256  # jax, journals, listen sockets, stdio, selector fds
+    cap = max(8, (soft - slack) // 2)
+    requested = n_conns
+    n_conns = min(n_conns, cap)
+    fd_budget = {"rlimit_nofile_soft": soft, "rlimit_nofile_hard": hard,
+                 "fd_slack": slack, "max_inproc_connections": cap,
+                 "requested_conns": requested, "conns": n_conns,
+                 "clamped": n_conns < requested}
+
+    def blobs_for(nc: int, req: int):
+        # pre-encoded per-connection request blobs: identical bytes on
+        # both legs; tenant ids scatter over n_tenants via coprime
+        # strides so the columnar table sees a wide population
+        return [b"".join(
+            encode_frame({"id": i,
+                          "tenant": f"t{(c * 7919 + i * 104729) % n_tenants}",
+                          "entity": "e", "op": "frontdoor_noop"})
+            for i in range(req)) for c in range(nc)]
+
+    def leg(transport: str, nc: int, req: int, blobs, record: bool = True):
+        system = None
+        if transport == "stream":
+            system = ActorSystem(f"c1m-{transport}-{nc}",
+                                 {"akka": {"stdout-loglevel": "OFF",
+                                           "log-dead-letters": 0}})
+        adm = AdmissionController(rate=1e9, burst=1e9)
+        srv = GatewayServer(system, None, adm, SloTracker(),
+                            transport=transport,
+                            aggregate=(transport == "stream"))
+        total = nc * req
+        try:
+            host, port = srv.start()
+            socks = []
+            t_c0 = time.perf_counter()
+            for c in range(nc):
+                for _attempt in range(100):
+                    try:
+                        s = _socket.create_connection((host, port),
+                                                      timeout=10.0)
+                        break
+                    except OSError:
+                        time.sleep(0.05)  # listen backlog under a burst
+                else:
+                    raise ConnectionError(
+                        f"{transport}: could not connect socket {c}/{nc}")
+                s.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+                s.setblocking(False)
+                socks.append(s)
+            connect_s = time.perf_counter() - t_c0
+            sel = _selectors.DefaultSelector()
+            for c, s in enumerate(socks):
+                st = {"sock": s, "out": memoryview(blobs[c]),
+                      "reader": FrameReader(), "got": 0}
+                sel.register(s, _selectors.EVENT_READ
+                             | _selectors.EVENT_WRITE, st)
+            done = 0
+            t0 = time.perf_counter()
+            deadline = t0 + 600.0
+            while done < nc:
+                if time.perf_counter() > deadline:
+                    raise TimeoutError(
+                        f"{transport}: {done}/{nc} conns done at +600s")
+                for key, events in sel.select(timeout=5.0):
+                    st = key.data
+                    s = st["sock"]
+                    if events & _selectors.EVENT_WRITE:
+                        try:
+                            sent = s.send(st["out"])
+                        except (BlockingIOError, InterruptedError):
+                            sent = 0
+                        st["out"] = st["out"][sent:]
+                        if not len(st["out"]):
+                            sel.modify(s, _selectors.EVENT_READ, st)
+                    if events & _selectors.EVENT_READ:
+                        try:
+                            data = s.recv(1 << 16)
+                        except (BlockingIOError, InterruptedError):
+                            continue
+                        if not data:
+                            raise ConnectionError(
+                                f"{transport}: server closed a "
+                                f"connection at {st['got']}/{req} replies")
+                        for _body in st["reader"].feed_raw(data):
+                            st["got"] += 1
+                        if st["got"] >= req:
+                            sel.unregister(s)
+                            s.close()
+                            done += 1
+            dt = time.perf_counter() - t0
+            sel.close()
+            if not record:
+                return None
+            ast = adm.stats()
+            row = {"transport": transport, "conns": nc, "per_conn": req,
+                   "requests": total, "connect_s": round(connect_s, 3),
+                   "wall_s": round(dt, 3),
+                   "req_per_sec": round(total / dt, 1),
+                   "admitted": adm.admitted, "rejected": adm.rejected,
+                   "resident_tenants": ast["resident_tenants"],
+                   "tenant_spills": ast["tenant_spills"]}
+            if transport == "evloop":
+                ev = srv._evloop.stats()
+                row["evloop"] = {k: ev[k] for k in
+                                 ("accepted", "max_connections",
+                                  "frames_in", "read_pauses",
+                                  "write_blocks", "wakeups_per_s",
+                                  "accept_shards")}
+            try:
+                row["host_loadavg"] = round(os.getloadavg()[0], 2)
+            except OSError:
+                pass
+            return row
+        finally:
+            srv.stop()
+            if system is not None:
+                system.terminate()
+                system.await_termination(10.0)
+
+    # tiny unrecorded warm pass per transport: allocator + code paths
+    warm = blobs_for(4, 4)
+    leg("stream", 4, 4, warm, record=False)
+    leg("evloop", 4, 4, warm, record=False)
+    blobs = blobs_for(n_conns, per_conn)
+    stream = leg("stream", n_conns, per_conn, blobs)
+    evloop = leg("evloop", n_conns, per_conn, blobs)
+    speedup = round(evloop["req_per_sec"]
+                    / max(stream["req_per_sec"], 1e-9), 2)
+    equal_admission = (stream["admitted"] == evloop["admitted"]
+                       == n_conns * per_conn
+                       and stream["rejected"] == evloop["rejected"] == 0)
+    return {"stream": stream, "evloop": evloop, "speedup": speedup,
+            "fd_budget": fd_budget, "n_tenants": n_tenants,
+            "equal_admission": equal_admission,
+            "ok": speedup >= 2.0 and equal_admission}
+
+
 def bench_gateway_slo(n_requests: int = 400, n_entities: int = 16):
     """gateway-slo: sustained request load through the serving gateway's
     in-proc ingress path (handle_frame -> admission -> region ask), two
@@ -2048,6 +2220,7 @@ def main() -> None:
                                          "metrics-overhead",
                                          "failover-mttr", "reshard-pause",
                                          "gateway-slo", "ingest-decode",
+                                         "c1m-frontdoor",
                                          "tracing-overhead",
                                          "spawn", "stream"],
                     help="run a single config (spawn/stream are extra "
@@ -2378,6 +2551,37 @@ def main() -> None:
                     "value": b["p99_ms"], "unit": "ms",
                     "vs_baseline": 1.0,
                     "extra": {"gateway": out, **extra}}))
+            elif args.config == "c1m-frontdoor":
+                # front-door transport A/B is host-side only (backend-free
+                # echo): scale is connection count, not actor count.
+                # --full asks for the 10k-conn / 100k-tenant datum (FD
+                # budget permitting — the bench clamps and says so).
+                if args.smoke:
+                    fd_c, fd_t, fd_r = 64, 2000, 8
+                elif args.full:
+                    fd_c, fd_t, fd_r = 10000, 100000, 16
+                else:
+                    fd_c, fd_t, fd_r = 256, 20000, 16
+                out = bench_c1m_frontdoor(n_conns=fd_c, n_tenants=fd_t,
+                                          per_conn=fd_r)
+                sl, el = out["stream"], out["evloop"]
+                print(f"[bench] c1m-frontdoor: {el['conns']} conns x "
+                      f"{el['per_conn']} req over {out['n_tenants']} "
+                      f"tenants | stream {sl['req_per_sec']}req/s "
+                      f"(connect {sl['connect_s']}s) vs evloop "
+                      f"{el['req_per_sec']}req/s "
+                      f"(connect {el['connect_s']}s) x{out['speedup']} | "
+                      f"fd cap {out['fd_budget']['max_inproc_connections']}"
+                      f" conns | equal_admission="
+                      f"{'OK' if out['equal_admission'] else 'FAIL'} "
+                      f"{'OK' if out['ok'] else 'FAIL'}", file=sys.stderr)
+                print(json.dumps({
+                    "metric": "gateway front-door throughput, selector "
+                              "evloop vs thread-per-connection (pipelined "
+                              "JSON over TCP, equal admission)" + scale_tag,
+                    "value": el["req_per_sec"], "unit": "req/sec",
+                    "vs_baseline": out["speedup"],
+                    "extra": {"frontdoor": out, **extra}}))
             elif args.config == "tracing-overhead":
                 import jax as _jax
 

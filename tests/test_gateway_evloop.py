@@ -1,0 +1,204 @@
+"""Selector event-loop front door (gateway/evloop.py, ISSUE 18
+tentpole a): the evloop transport against its stream A/B twin —
+roundtrip, per-connection FIFO on BOTH transports, and the slow-consumer
+backpressure twin (a stalled reader stalls only itself).
+
+Tier-1 scope: the roundtrip/equivalence tests ride a fresh region of the
+warm "gwb" spec shape (2 shards x 8 entities, 2 devices, payload width
+4); everything else is backend-free JSON echo traffic. Windows stay
+<= 64 rows."""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import pytest
+
+from akka_tpu import ActorSystem
+from akka_tpu.gateway import (AdmissionController, GatewayClient,
+                              GatewayServer, RegionBackend, SloTracker,
+                              counter_behavior)
+from akka_tpu.gateway.ingress import FrameReader, encode_frame
+
+
+def _fresh_region():
+    from akka_tpu.sharding.device import DeviceEntity, DeviceShardRegion
+    spec = DeviceEntity("gwb", counter_behavior(4), n_shards=2,
+                        entities_per_shard=8, n_devices=2, payload_width=4)
+    return DeviceShardRegion(spec)
+
+
+def _mk_system(name):
+    return ActorSystem(name, {"akka": {"stdout-loglevel": "OFF",
+                                       "log-dead-letters": 0}})
+
+
+def _echo_server(transport, system=None, **kw):
+    """Backend-free server: unknown ops echo typed errors, no region."""
+    return GatewayServer(system, None,
+                         AdmissionController(rate=1e9, burst=1e9),
+                         SloTracker(), transport=transport,
+                         aggregate=(transport == "stream"), **kw)
+
+
+# ---------------------------------------------------------------- roundtrip
+def test_evloop_tcp_roundtrip():
+    """The stream roundtrip test's evloop twin: same client, same wire
+    protocol, region-backed adds/gets plus the admin sum — no actor
+    system needed for the transport itself."""
+    region = _fresh_region()
+    srv = GatewayServer(None, RegionBackend(region),
+                        AdmissionController(rate=1e6, burst=1e6),
+                        SloTracker(), transport="evloop")
+    host, port = srv.start()
+    client = GatewayClient(host, port)
+    try:
+        base = float(client.admin("sum")["value"])
+        assert client.request("t9", "ev-acct", "add", 2.5)["status"] == "ok"
+        rep = client.request("t9", "ev-acct", "add", 1.5)
+        assert rep["status"] == "ok" and rep["value"] == pytest.approx(4.0)
+        assert client.request("t9", "ev-acct", "get")["value"] == \
+            pytest.approx(4.0)
+        assert float(client.admin("sum")["value"]) == \
+            pytest.approx(base + 4.0)
+    finally:
+        client.close()
+        srv.stop()
+
+
+def test_transport_ab_equivalence_one_region():
+    """A/B contract: the two transports speak the same wire protocol
+    over the same serve path — identical reply dicts for the same
+    request schedule (fresh entities per leg so device state aligns),
+    and identical admitted/rejected admission counters."""
+    region = _fresh_region()
+    system = _mk_system("gw-ab-ev")
+    schedule = [("add", 2.0), ("add", 3.5), ("get", 0.0),
+                ("bogus_op", 1.0)]
+    legs = {}
+    try:
+        for transport, entity in (("stream", "ab-s"), ("evloop", "ab-e")):
+            adm = AdmissionController(rate=1e6, burst=1e6)
+            srv = GatewayServer(system, RegionBackend(region), adm,
+                                SloTracker(), transport=transport)
+            host, port = srv.start()
+            client = GatewayClient(host, port)
+            try:
+                reps = [client.request("tA", entity, op, v)
+                        for op, v in schedule]
+            finally:
+                client.close()
+                srv.stop()
+            for r in reps:
+                r.pop("id", None)
+            legs[transport] = (reps, adm.stats()["admitted"],
+                               adm.stats()["rejected"])
+    finally:
+        system.terminate()
+        system.await_termination(10.0)
+    assert legs["stream"] == legs["evloop"]
+
+
+# ------------------------------------------------------- per-connection FIFO
+@pytest.mark.parametrize("transport", ["stream", "evloop"])
+def test_per_connection_fifo_both_transports(transport):
+    """Acceptance criterion: two connections pipeline interleaved JSON
+    frames through the shared aggregator; each gets its replies back in
+    exactly its own submit order (the stream leg runs aggregate=True so
+    both legs exercise the windowed path)."""
+    system = _mk_system(f"gw-fifo-{transport}") \
+        if transport == "stream" else None
+    srv = _echo_server(transport, system)
+    N = 60
+    try:
+        host, port = srv.start()
+        socks = [socket.create_connection((host, port)) for _ in range(2)]
+        for s in socks:
+            s.settimeout(60.0)
+        for j, s in enumerate(socks):
+            s.sendall(b"".join(
+                encode_frame({"id": i, "tenant": f"t{j}", "entity": "e",
+                              "op": "zzz"}) for i in range(N)))
+        for s in socks:
+            reader, got = FrameReader(), []
+            while len(got) < N:
+                data = s.recv(65536)
+                assert data, "connection died mid-drain"
+                got.extend(reader.feed(data))
+            assert [g["id"] for g in got] == list(range(N))
+            assert all(g["reason"].startswith("unknown_op:") for g in got)
+            s.close()
+    finally:
+        srv.stop()
+        if system is not None:
+            system.terminate()
+            system.await_termination(10.0)
+
+
+# ------------------------------------------------------------- backpressure
+def test_evloop_slow_consumer_backpressure():
+    """The stream slow-consumer test's evloop twin: a stalled reader's
+    replies pile into ITS outbuf until the high-water mark drops the
+    socket's read interest — processing plateaus below the request
+    count — while a second live connection keeps being served; the
+    stalled one then drains with zero loss and intact ordering."""
+    N, OP = 240, "x" * 30000  # unknown op -> ~30KB echo reply
+    slo = SloTracker()
+    srv = GatewayServer(None, None,
+                        AdmissionController(rate=1e9, burst=1e9), slo,
+                        max_frame=1 << 16, transport="evloop")
+    try:
+        host, port = srv.start()
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+        sock.settimeout(120.0)
+        sock.connect((host, port))
+        blob = b"".join(
+            encode_frame({"id": i, "tenant": "t", "entity": "e", "op": OP})
+            for i in range(N))
+        sender = threading.Thread(target=sock.sendall, args=(blob,),
+                                  daemon=True)
+        sender.start()
+
+        def processed():
+            return slo.artifact()["requests"]
+
+        last, stable_since = -1, time.monotonic()
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            cur = processed()
+            if cur != last:
+                last, stable_since = cur, time.monotonic()
+            elif cur > 0 and time.monotonic() - stable_since > 1.0:
+                break  # plateaued: backpressure reached the producer
+            time.sleep(0.05)
+        plateau = processed()
+        assert 0 < plateau < N, \
+            f"no backpressure: {plateau}/{N} processed while stalled"
+        assert srv._evloop.stats()["read_pauses"] > 0
+
+        # the stall is per-connection: a second socket stays live
+        live = GatewayClient(host, port)
+        assert live.request("t2", "e", "ping_op", 0.0)["reason"] \
+            .startswith("unknown_op:")
+        live.close()
+        assert processed() == plateau + 1
+
+        # resume: drain everything — no drops, order preserved
+        reader = FrameReader(max_frame=1 << 20)
+        got = []
+        while len(got) < N:
+            data = sock.recv(65536)
+            assert data, f"connection died after {len(got)}/{N} replies"
+            got.extend(reader.feed(data))
+        sender.join(timeout=60.0)
+        assert not sender.is_alive()
+        assert [g["id"] for g in got] == list(range(N))
+        assert all(g["status"] == "error" and
+                   g["reason"].startswith("unknown_op:") for g in got)
+        assert processed() == N + 1
+        sock.close()
+    finally:
+        srv.stop()

@@ -1,0 +1,226 @@
+"""Columnar tenant admission (gateway/admission.VectorTenantTable,
+ISSUE 18 tentpole b): grant parity with scalar TokenBuckets — bit-equal,
+not approximate — plus LRU spill/rehydrate round trips and the
+open-wave-depth pressure signal satellite.
+
+Tier-1 scope: everything here is hostside numpy + dict work; no region,
+no device, sub-second."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from akka_tpu.event.pressure import PressureReader, system_pressure_sources
+from akka_tpu.gateway.admission import (AdmissionController, Reject,
+                                        TokenBucket, VectorTenantTable,
+                                        region_pressure_signals)
+from akka_tpu.testkit.chaos import chaos_uniform_np
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _shadow_tokens(table: VectorTenantTable, tenant: str) -> float:
+    """The table's raw token float for `tenant`, resident or spilled."""
+    s = table._slot_of.get(tenant)
+    if s is not None:
+        return float(table._tokens[s])
+    return table._spilled[tenant][0]
+
+
+# ------------------------------------------------------------ grant parity
+def test_grant_parity_property_murmur3():
+    """The acceptance-criteria property: over murmur3-seeded random
+    (tenant, n, dt) sequences, the ONE vectorized `charge_groups`
+    refill+debit grants exactly what sequential `TokenBucket.acquire_upto`
+    grants — admitted counts equal, retry_after bit-equal, token floats
+    bit-equal — including across LRU spill/rehydrate round trips
+    (max_resident=4 over a 12-tenant population forces them)."""
+    fc = FakeClock()
+    rate, burst = 3.0, 7.5  # fractional burst: floor matters
+    table = VectorTenantTable(rate, burst, max_resident=4, init_capacity=2)
+    shadow = {}
+    tenants = [f"t{i}" for i in range(12)]
+    for step in range(300):
+        u = chaos_uniform_np(0xC1A0, step, np.arange(12), salt=7)
+        fc.advance(float(u[0]) * 0.5)
+        now = fc()
+        # a window of 1..4 distinct tenants, counts 0..5
+        m = 1 + int(u[1] * 4)
+        picks = list(dict.fromkeys(
+            tenants[int(u[2 + j] * 12)] for j in range(m)))
+        counts = [int(u[6 + j] * 6) for j in range(len(picks))]
+        for t in picks:
+            if t not in shadow:
+                shadow[t] = TokenBucket(rate, burst, clock=fc)
+        ks, retry = table.charge_groups(picks, counts, now)
+        for j, t in enumerate(picks):
+            want_k = shadow[t].acquire_upto(counts[j])
+            assert int(ks[j]) == want_k, (step, t)
+            want_retry = shadow[t].retry_after()
+            assert float(retry[j]) == want_retry, (step, t)  # bit-equal
+            assert _shadow_tokens(table, t) == shadow[t]._tokens, (step, t)
+        # interleave the scalar admit path on one tenant
+        if step % 7 == 0:
+            t = tenants[int(u[10] * 12)]
+            if t not in shadow:
+                shadow[t] = TokenBucket(rate, burst, clock=fc)
+            got = table.acquire_upto(t, 2, now)
+            assert got == shadow[t].acquire_upto(2)
+            assert _shadow_tokens(table, t) == shadow[t]._tokens
+    assert table.spills > 0 and table.rehydrates > 0, \
+        "property run never exercised the LRU spill path"
+    assert table.resident <= 4
+
+
+def test_lru_spill_rehydrate_bit_equal():
+    """An LRU round trip is bit-invisible: the evicted tenant's raw
+    (tokens, last_refill) floats come back exactly, so its next charge
+    matches an uninterrupted scalar bucket's."""
+    fc = FakeClock()
+    table = VectorTenantTable(2.0, 5.0, max_resident=2, init_capacity=1)
+    bucket = TokenBucket(2.0, 5.0, clock=fc)  # shadow for "a" only
+    assert table.acquire_upto("a", 3, fc()) == bucket.acquire_upto(3) == 3
+    fc.advance(0.3)
+    table.acquire_upto("b", 1, fc())
+    fc.advance(0.3)
+    table.acquire_upto("c", 1, fc())  # capacity 2: evicts LRU ("a")
+    assert table.spills == 1 and "a" in table._spilled
+    assert table.resident == 2 and table.tenant_count == 3
+    spilled_tokens, spilled_last = table._spilled["a"]
+    assert spilled_tokens == bucket._tokens
+    assert spilled_last == bucket._last
+    fc.advance(1.7)
+    assert table.acquire_upto("a", 4, fc()) == bucket.acquire_upto(4)
+    assert table.rehydrates == 1
+    assert _shadow_tokens(table, "a") == bucket._tokens
+
+
+def test_capacity_grows_before_evicting():
+    table = VectorTenantTable(1.0, 1.0, max_resident=8, init_capacity=2)
+    for i in range(8):
+        table.acquire_upto(f"t{i}", 1, float(i))
+    assert table.resident == 8 and table.spills == 0
+    table.acquire_upto("t9", 1, 9.0)
+    assert table.spills == 1 and table.resident == 8
+
+
+def test_admit_groups_is_one_vector_charge_no_bucket_objects():
+    """Acceptance criterion: the window charge does zero per-tenant
+    Python-object walks for resident tenants — no TokenBucket objects
+    exist in the controller at all, and each admit_groups call is ONE
+    vectorized charge."""
+    fc = FakeClock()
+    adm = AdmissionController(rate=2.0, burst=3.0, clock=fc)
+    assert not hasattr(adm, "_buckets")
+    out = adm.admit_groups({"a": 2, "b": 5})
+    assert adm.table.vector_charges == 1
+    assert out["a"] == (2, None)
+    k, rej = out["b"]
+    assert k == 3 and isinstance(rej, Reject) \
+        and rej.reason == "rate_limited"
+    assert rej.retry_after_s == round(1.0 / 2.0, 3)
+    fc.advance(1.0)
+    out = adm.admit_groups({"a": 4, "c": 1})
+    assert adm.table.vector_charges == 2
+    # a refilled to min(3, 1 + 2) = 3: grants 3 of 4
+    assert out["a"] == (3, Reject("rate_limited", 0.5))
+    assert out["c"] == (1, None)
+    st = adm.stats()
+    assert st["admitted"] == 9 and st["rejected"] == 3
+    assert st["resident_tenants"] == 3 and st["tenants"] == 3
+
+
+def test_admit_scalar_parity_and_retry_after():
+    """Scalar admit() path against a shadow bucket, including the
+    rate_limited retry_after round()."""
+    fc = FakeClock()
+    adm = AdmissionController(rate=2.0, burst=2.0, clock=fc)
+    bucket = TokenBucket(2.0, 2.0, clock=fc)
+    for _ in range(2):
+        assert adm.admit("t") is None
+        assert bucket.try_acquire()
+    rej = adm.admit("t")
+    assert not bucket.try_acquire()
+    assert rej.reason == "rate_limited"
+    assert rej.retry_after_s == round(bucket.retry_after(), 3)
+
+
+# ------------------------------------------------- open-wave-depth pressure
+def test_admission_sheds_on_open_wave_depth():
+    """ISSUE 18 satellite regression: with the wave pipeline full
+    (open waves == pipeline_depth -> level 1.0), admission trips
+    "overloaded:open_wave_depth" BEFORE the promise pool reports
+    exhaustion, and recovers after the cooldown once waves drain."""
+    fc = FakeClock()
+    depth = [1.0]  # full pipeline
+    adm = AdmissionController(
+        rate=1e9, burst=1e9,
+        pressure_signals={"open_wave_depth": lambda: depth[0]},
+        thresholds={"open_wave_depth": 0.75},
+        check_interval_s=0.0, cooldown_s=0.25, clock=fc)
+    rej = adm.admit("t")
+    assert rej is not None and rej.reason == "overloaded:open_wave_depth"
+    out = adm.admit_groups({"t": 4})
+    assert out["t"][0] == 0
+    assert out["t"][1].reason == "overloaded:open_wave_depth"
+    depth[0] = 0.0  # waves drained
+    fc.advance(0.3)  # past the cooldown
+    assert adm.admit("t") is None
+    assert adm.stats()["signal_open_wave_depth"] == 0.0
+
+
+def test_open_wave_depth_in_pressure_sources():
+    """system_pressure_sources/region_pressure_signals carry the new
+    signal when a batcher is wired, and omit it otherwise."""
+    class Sys:
+        mailbox_overflow = 0.0
+        dropped_per_shard = np.zeros(2)
+        metrics_on = False
+
+    class Region:
+        system = Sys()
+
+        @staticmethod
+        def ask_pool_stats():
+            return {"occupancy": 0.5}
+
+    class Batcher:
+        @staticmethod
+        def open_wave_depth():
+            return 0.75
+
+    src = system_pressure_sources(Region(), open_wave_depth=lambda: 1.0)
+    assert src["open_wave_depth"]() == 1.0
+    sig = region_pressure_signals(Region(), batcher=Batcher())
+    assert sig["open_wave_depth"]() == 0.75
+    assert "open_wave_depth" not in region_pressure_signals(Region())
+    # it is a LEVEL, not a cumulative counter: PressureReader must not
+    # delta it
+    reader = PressureReader({"open_wave_depth": lambda: 0.9})
+    assert reader.read()["open_wave_depth"] == 0.9
+    assert reader.read()["open_wave_depth"] == 0.9
+
+
+def test_askbatcher_reports_open_wave_depth_serialized():
+    """Serialized batcher (no scheduler): depth is in-flight engine
+    calls over pipeline_depth — 0.0 when quiet."""
+    from akka_tpu.sharding.ask_batch import AskBatcher
+    b = AskBatcher.__new__(AskBatcher)
+    import threading
+    b._sched = None
+    b._lock = threading.Lock()
+    b._executing = 0
+    b.pipeline_depth = 4
+    assert b.open_wave_depth() == 0.0
+    b._executing = 2
+    assert b.open_wave_depth() == 0.5

@@ -540,6 +540,44 @@ def on_tpu_found(detail: str) -> None:
                             "continuous_speedup_64":
                                 ca.get("speedup_64"),
                             "conserved": ca.get("conserved")})
+    # C1M front door (ISSUE 18): selector evloop vs thread-per-connection
+    # stream transport over real TCP at equal admission — the row is ok
+    # when evloop req/s >= 2x the threaded leg with identical
+    # admitted/rejected counters; the FD-budget max-connections datum
+    # rides alongside
+    run_logged("frontdoor", [sys.executable, "bench.py", "--config",
+                             "c1m-frontdoor", "--probe-timeout", "120"],
+               timeout_s=1800)
+    fd_out = os.path.join(REPO, "watchdog_frontdoor.out")
+    if os.path.exists(fd_out):
+        fdj = None
+        for line in open(fd_out):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    fdj = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
+        fd = (fdj or {}).get("extra", {}).get("frontdoor", {})
+        if fd:
+            el = fd.get("evloop", {})
+            sl = fd.get("stream", {})
+            append_log({"ts": _utcnow(),
+                        "ok": bool(fd.get("ok")) and
+                              bool(fd.get("equal_admission")),
+                        "detail": "C1M front door transport A/B "
+                                  "(evloop vs stream, equal admission)",
+                        "frontdoor_speedup": fd.get("speedup"),
+                        "evloop_req_per_sec": el.get("req_per_sec"),
+                        "stream_req_per_sec": sl.get("req_per_sec"),
+                        "conns": el.get("conns"),
+                        "n_tenants": fd.get("n_tenants"),
+                        "resident_tenants": el.get("resident_tenants"),
+                        "max_inproc_connections":
+                            fd.get("fd_budget", {})
+                            .get("max_inproc_connections"),
+                        "read_pauses":
+                            el.get("evloop", {}).get("read_pauses")})
     # wire-decode throughput: batch np.frombuffer vs json.loads, plus the
     # full-path 1/8/64-client encoding sweep (docs/SERVING_GATEWAY.md
     # wire-protocol section)
@@ -641,7 +679,8 @@ def on_tpu_found(detail: str) -> None:
              "watchdog_trace.out", "watchdog_supervision.out",
              "watchdog_bridge.out", "watchdog_checkpoint.out",
              "watchdog_metrics.out", "watchdog_failover.out",
-             "watchdog_gateway.out", "watchdog_ingest.out",
+             "watchdog_gateway.out", "watchdog_frontdoor.out",
+             "watchdog_ingest.out",
              "watchdog_tracing.out", "watchdog_reshard.out"]
     if last is not None:
         paths.append("BENCH_TPU.json")

@@ -280,16 +280,21 @@ def validate_trace(doc: Dict[str, Any]) -> List[str]:
                 continue
             tracks.setdefault((e.get("pid"), e.get("tid")), []).append(e)
     # nesting: within one (pid, tid) row, complete events must form a
-    # stack — overlap without containment renders as garbage
+    # stack — overlap without containment renders as garbage. Tolerance
+    # is float-aware, not zero: ts comes from monotonic*1e6 minus a
+    # base, so adjacent spans that tile exactly in seconds can disagree
+    # by ~ulp(monotonic*1e6) ≈ 1e-4 us after days of uptime; real
+    # overlap bugs are >> half a microsecond.
+    eps = 0.5
     for key, track in tracks.items():
         track.sort(key=lambda e: (e["ts"], -e["dur"]))
         stack: List[Dict[str, Any]] = []
         for e in track:
             while stack and e["ts"] >= stack[-1]["ts"] + stack[-1]["dur"] \
-                    - 1e-6:
+                    - eps:
                 stack.pop()
             if stack and e["ts"] + e["dur"] > stack[-1]["ts"] \
-                    + stack[-1]["dur"] + 1e-6:
+                    + stack[-1]["dur"] + eps:
                 errs.append(f"track {key}: {e['name']} overlaps "
                             f"{stack[-1]['name']} without nesting")
             stack.append(e)
