@@ -8,6 +8,7 @@ Four planes, each its own module:
 - aggregator: cross-connection ingest windows (shared decode/admission/
               ask waves across sockets)
 - admission:  per-tenant token buckets + runtime-pressure load shedding
+- dedup:      journaled reply-cache dedup (exactly-once retry effects)
 - slo:        p50/p99 latency vs targets, error budget, per-tenant counters
 """
 
@@ -15,6 +16,7 @@ from .admission import (AdmissionController, AskPoolExhausted, Reject,
                         TokenBucket, VectorTenantTable,
                         handle_pressure_signals, region_pressure_signals)
 from .aggregator import IngestAggregator
+from .dedup import ReplyCacheTable
 from .evloop import EvLoopIngress
 from .ingress import (DEFAULT_MAX_FRAME, GatewayClient, GatewayServer,
                       RegionBackend, counter_behavior, encode_body,
@@ -23,7 +25,8 @@ from .slo import SloTracker
 from ..serialization import frames
 
 __all__ = ["AdmissionController", "AskPoolExhausted", "Reject",
-           "TokenBucket", "VectorTenantTable", "EvLoopIngress",
+           "TokenBucket", "VectorTenantTable", "ReplyCacheTable",
+           "EvLoopIngress",
            "handle_pressure_signals",
            "region_pressure_signals", "GatewayClient", "GatewayServer",
            "IngestAggregator", "RegionBackend", "counter_behavior",

@@ -72,7 +72,7 @@ class _EvConn:
 
     __slots__ = ("sock", "fd", "conn_id", "reader", "pending", "inflight",
                  "replies", "outbuf", "out_len", "mask", "read_done",
-                 "closed")
+                 "closed", "last_rx")
 
     def __init__(self, sock: socket.socket, conn_id: int, max_frame: int):
         from .ingress import FrameReader
@@ -88,6 +88,7 @@ class _EvConn:
         self.mask = 0                    # currently-registered selector mask
         self.read_done = False           # peer half-closed
         self.closed = False
+        self.last_rx = time.monotonic()  # idle-reap clock (ISSUE 20)
 
 
 class _AcceptShard(threading.Thread):
@@ -111,6 +112,7 @@ class _AcceptShard(threading.Thread):
         self._rd_wake.setblocking(False)
         self._wr_wake.setblocking(False)
         self._halt = False
+        self._last_sweep = time.monotonic()
 
     # ---------------------------------------------------- cross-thread API
     def notify(self, conn: _EvConn) -> None:
@@ -155,6 +157,7 @@ class _AcceptShard(threading.Thread):
                         if mask & selectors.EVENT_READ and not conn.closed:
                             self._read_ready(conn)
                 self._drain_completions()
+                self._reap_idle()
                 if self._halt:
                     return
         finally:
@@ -168,6 +171,29 @@ class _AcceptShard(threading.Thread):
             self._rd_wake.close()
             self._wr_wake.close()
             self.sel.close()
+
+    # ---------------------------------------------------------- idle reaping
+    def _reap_idle(self) -> None:
+        """Close connections with no frame for `idle_timeout_s` (ISSUE
+        20 satellite; 0 = off, the default). Only fully-quiescent
+        connections reap — anything with parsed-but-unsubmitted frames,
+        in-flight windows or unflushed reply bytes is WORKING, not idle.
+        Swept at most once a second off the selector's 1s tick, so the
+        cost is one timestamp compare per connection per second."""
+        ing = self.ingress
+        timeout = ing.idle_timeout_s
+        if timeout <= 0:
+            return
+        now = time.monotonic()
+        if now - self._last_sweep < min(1.0, timeout / 2):
+            return
+        self._last_sweep = now
+        for conn in [c for c in self.conns.values()
+                     if not c.closed and not c.pending and not c.replies
+                     and not c.outbuf and c.inflight == 0
+                     and now - c.last_rx > timeout]:
+            self._close(conn)
+            ing._idle_reaped += 1
 
     # -------------------------------------------------------------- accept
     def _accept_ready(self) -> None:
@@ -206,6 +232,7 @@ class _AcceptShard(threading.Thread):
             conn.read_done = True
             self._maybe_finish(conn)
             return
+        conn.last_rx = time.monotonic()
         ing._bytes_in += len(data)
         try:
             for body in conn.reader.feed_raw(data):
@@ -357,7 +384,7 @@ class EvLoopIngress:
 
     def __init__(self, server, host: str = "127.0.0.1", port: int = 0,
                  n_shards: int = 1, backlog: int = 4096,
-                 registry=None):
+                 registry=None, idle_timeout_s: float = 0.0):
         if server.aggregator is None:
             raise ValueError("evloop transport requires the shared "
                              "IngestAggregator (GatewayServer creates it "
@@ -370,6 +397,9 @@ class EvLoopIngress:
         self.port = int(port)
         self.n_shards = max(1, int(n_shards))
         self.backlog = int(backlog)
+        # idle-connection reaping (ISSUE 20 satellite): 0 disables
+        self.idle_timeout_s = float(idle_timeout_s)
+        self._idle_reaped = 0
         self._shards: List[_AcceptShard] = []
         self._conn_lock = threading.Lock()
         self._started = False
@@ -444,4 +474,6 @@ class EvLoopIngress:
                 "write_blocks": float(self._write_blocks),
                 "wakeups": float(self._wakeups),
                 "wakeups_per_s": self._wakeups / elapsed,
+                "idle_reaped": float(self._idle_reaped),
+                "idle_timeout_s": float(self.idle_timeout_s),
                 "accept_shards": float(self.n_shards)}

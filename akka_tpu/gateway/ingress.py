@@ -67,6 +67,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import random
 import socket
 import struct
 import threading
@@ -79,7 +80,9 @@ import numpy as np
 from ..batched.bridge import AskPoolExhausted
 from ..event.tracing import reset_ctx, set_ctx
 from ..serialization import frames
+from ..pattern.backoff import backoff_delay
 from .admission import AdmissionController
+from .dedup import DUPLICATE_INFLIGHT
 from .slo import SloTracker
 
 __all__ = ["encode_frame", "encode_body", "FrameReader", "counter_behavior",
@@ -200,11 +203,12 @@ class RegionBackend:
 
     def _resolve_wave(self, entity_ids: Sequence[str],
                       values: Sequence[float],
-                      ctxs: Optional[Sequence[Any]]):
+                      ctxs: Optional[Sequence[Any]],
+                      keys: Optional[Sequence[Any]] = None):
         """Shared wave prep: entity ids resolved ONCE per unique id;
         unresolvable entities land their typed exception in `out`
         directly; the rest compact into (shard, index, payload) requests
-        with aligned origin slots and span contexts."""
+        with aligned origin slots, span contexts and dedup keys."""
         refs: Dict[str, Any] = {}
         for e in entity_ids:
             if e not in refs:
@@ -214,6 +218,7 @@ class RegionBackend:
                     refs[e] = exc
         reqs, slots = [], []
         req_ctxs: Optional[List[Any]] = [] if ctxs is not None else None
+        req_keys: Optional[List[Any]] = [] if keys is not None else None
         out: List[Any] = [None] * len(entity_ids)
         for i, (e, v) in enumerate(zip(entity_ids, values)):
             r = refs[e]
@@ -224,12 +229,15 @@ class RegionBackend:
             slots.append(i)
             if req_ctxs is not None:
                 req_ctxs.append(ctxs[i])
-        return out, reqs, slots, req_ctxs
+            if req_keys is not None:
+                req_keys.append(keys[i])
+        return out, reqs, slots, req_ctxs, req_keys
 
     def ask_many(self, entity_ids: Sequence[str],
                  values: Sequence[float],
                  ctxs: Optional[Sequence[Any]] = None,
-                 with_seqs: bool = False):
+                 with_seqs: bool = False,
+                 keys: Optional[Sequence[Any]] = None):
         """Columnar wave ask for a decoded binary window: entity ids are
         resolved ONCE per unique id, the whole wave rides
         `AskBatcher.ask_many` (one coalesced flush + one shared step
@@ -245,18 +253,24 @@ class RegionBackend:
         `with_seqs` (ISSUE 16): also return the aligned per-member
         resolve ordinals (continuous mode; None under the serialized
         engine, where waves already resolve in submit order) — the
-        gateway's replica-publish monotonicity key."""
-        out, reqs, slots, req_ctxs = self._resolve_wave(
-            entity_ids, values, ctxs)
+        gateway's replica-publish monotonicity key.
+
+        `keys` (ISSUE 20): optional aligned per-request dedup keys —
+        `(tenant, id)` tuples (or None) that ride the wave into the
+        entity journal's group commit, so ok replies are durable before
+        their acks (the reply-cache's commit-before-ack contract)."""
+        out, reqs, slots, req_ctxs, req_keys = self._resolve_wave(
+            entity_ids, values, ctxs, keys)
         seqs_out: Optional[List[int]] = None
         if reqs:
             rseqs = None
             if self.batcher is not None:
                 if with_seqs:
                     replies, rseqs = self.batcher.ask_many(
-                        reqs, req_ctxs, with_seqs=True)
+                        reqs, req_ctxs, with_seqs=True, keys=req_keys)
                 else:
-                    replies = self.batcher.ask_many(reqs, req_ctxs)
+                    replies = self.batcher.ask_many(reqs, req_ctxs,
+                                                    keys=req_keys)
             else:
                 replies = self.region.ask_many(
                     reqs, steps=self.steps,
@@ -273,15 +287,16 @@ class RegionBackend:
     def ask_many_async(self, entity_ids: Sequence[str],
                        values: Sequence[float],
                        ctxs: Optional[Sequence[Any]],
-                       on_done: Callable[[List[Any], List[int]], Any]
-                       ) -> None:
+                       on_done: Callable[[List[Any], List[int]], Any],
+                       keys: Optional[Sequence[Any]] = None) -> None:
         """Continuous-mode async wave (ISSUE 16): refs resolve and the
         wave STAGES on the calling thread (staging order is the
         linearization order, so per-connection ordering is preserved);
         `on_done(outcomes, seqs)` — both aligned with `entity_ids` —
-        fires at the wave's resolve boundary on the scheduler thread."""
-        out, reqs, slots, req_ctxs = self._resolve_wave(
-            entity_ids, values, ctxs)
+        fires at the wave's resolve boundary on the scheduler thread.
+        `keys` as in `ask_many` (ISSUE 20)."""
+        out, reqs, slots, req_ctxs, req_keys = self._resolve_wave(
+            entity_ids, values, ctxs, keys)
         seqs_out = [0] * len(entity_ids)
         if not reqs:
             on_done(out, seqs_out)
@@ -294,7 +309,7 @@ class RegionBackend:
                 seqs_out[i] = int(s)
             on_done(out, seqs_out)
 
-        self.batcher.ask_many_async(reqs, req_ctxs, _done)
+        self.batcher.ask_many_async(reqs, req_ctxs, _done, keys=req_keys)
 
     def close(self) -> None:
         if self.batcher is not None:
@@ -373,12 +388,20 @@ class _ServeState:
     __slots__ = ("aux", "ids", "ops", "tenants", "status", "reason",
                  "value", "retry", "step_lag", "traces", "roots",
                  "slo_outcomes", "slo_lat", "slo_rep", "serve", "vals",
-                 "ents", "ctxs")
+                 "ents", "ctxs", "dedup", "dedup_keys", "dedup_alias",
+                 "ask_keys")
 
     def __init__(self) -> None:
         self.slo_outcomes: Dict[bytes, List[str]] = {}
         self.slo_lat: Dict[bytes, List[Optional[float]]] = {}
         self.slo_rep: Dict[bytes, List[bool]] = {}
+        # reply-cache dedup (ISSUE 20): flag column (None = dedup off),
+        # row -> pending (tenant, id) key awaiting record/release, and
+        # same-window duplicate row -> its source row
+        self.dedup: Optional[np.ndarray] = None
+        self.dedup_keys: Dict[int, Tuple[str, int]] = {}
+        self.dedup_alias: Dict[int, int] = {}
+        self.ask_keys: Optional[List[Any]] = None
 
 
 # ------------------------------------------------------------------- server
@@ -392,7 +415,8 @@ class GatewayServer:
                  tracer=None, aggregate: bool = False,
                  max_window: int = 64, window_wait_s: float = 150e-6,
                  pipeline_depth: int = 4, replica_cache=None,
-                 transport: str = "stream", accept_shards: int = 1):
+                 transport: str = "stream", accept_shards: int = 1,
+                 dedup=None, idle_timeout_s: float = 0.0):
         if transport not in ("stream", "evloop"):
             raise ValueError(f"unknown transport {transport!r} "
                              "(expected 'stream' or 'evloop')")
@@ -416,6 +440,26 @@ class GatewayServer:
             replayed = getattr(region, "_durable_replayed_totals", None)
             if replayed is not None:
                 replica_cache.republish_restored(replayed)
+        # exactly-once effects (ISSUE 20): optional ReplyCacheTable —
+        # duplicate request ids short-circuit with the cached reply
+        # instead of re-entering the ask wave. Ok replies rode the
+        # entity journal's group commit (`append_wave(replies=)`), so a
+        # region restored before the gateway came up replayed the dedup
+        # frontier too — rehydrate it before first serve, the replica
+        # republish_restored twin above.
+        self.dedup = dedup
+        self._dedup_lock = threading.Lock()
+        self.idle_timeout_s = float(idle_timeout_s)
+        if dedup is not None:
+            region = getattr(backend, "region", None)
+            ej = getattr(region, "_entity_journal", None)
+            replayed_replies = getattr(ej, "replies", None)
+            if replayed_replies is not None:
+                entries = replayed_replies()
+                if entries:
+                    dedup.load(entries)
+            if registry is not None:
+                registry.register_collector("gateway_dedup", dedup.stats)
         self.host = host
         self.port = port
         self.max_frame = max_frame
@@ -477,7 +521,8 @@ class GatewayServer:
             from .evloop import EvLoopIngress
             self._evloop = EvLoopIngress(
                 self, host=self.host, port=self.port,
-                n_shards=self.accept_shards, registry=self._registry)
+                n_shards=self.accept_shards, registry=self._registry,
+                idle_timeout_s=self.idle_timeout_s)
             self.host, self.port = self._evloop.start()
             return self.host, self.port
         from ..stream.dsl import Keep, Sink
@@ -663,7 +708,8 @@ class GatewayServer:
                 except BaseException as e:  # noqa: BLE001 — never hang
                     fut.set_exception(e)
 
-            self.backend.ask_many_async(st.ents, st.vals, st.ctxs, _done)
+            self.backend.ask_many_async(st.ents, st.vals, st.ctxs, _done,
+                                        keys=st.ask_keys)
         except BaseException as e:  # noqa: BLE001 — never hang the caller
             fut.set_exception(e)
         return fut
@@ -752,7 +798,7 @@ class GatewayServer:
         encoding, plus the window-level join span. Runs on the serving
         thread in the synchronous path and at the wave's resolve
         boundary in the continuous path."""
-        ids, status, reason, value, retry, traces, step_lag = cols
+        ids, status, reason, value, retry, traces, step_lag, dedups = cols
         tr = self._tracer
         if tr is not None and traces is not None and len(windowed) > 1:
             member = [int(t) for t in traces if t]
@@ -770,11 +816,12 @@ class GatewayServer:
                     ids[lo:hi], status[lo:hi], reason[lo:hi],
                     value[lo:hi], retry[lo:hi],
                     None if traces is None else traces[lo:hi],
-                    step_lag[lo:hi])
+                    step_lag[lo:hi],
+                    None if dedups is None else dedups[lo:hi])
             else:
                 out[f] = encode_body(self._row_reply(
                     lo, ids, status, reason, value, retry, traces, aux,
-                    step_lag))
+                    step_lag, dedups))
 
     @staticmethod
     def _columnize_mixed(rec_bin, bin_idx: List[int],
@@ -831,7 +878,7 @@ class GatewayServer:
     @staticmethod
     def _row_reply(r: int, ids, status, reason, value, retry, traces,
                    aux: Optional[_WindowAux],
-                   step_lag=None) -> Dict[str, Any]:
+                   step_lag=None, dedups=None) -> Dict[str, Any]:
         """One window row back to the exact reply dict the scalar JSON
         path built: per-status key set, raw id echo, untruncated
         reasons, trace id on sampled replies; replica-served reads carry
@@ -854,6 +901,8 @@ class GatewayServer:
                 bytes(reason[r]).rstrip(b"\x00").decode("utf-8", "replace")
             if st == frames.ST_SHED:
                 rep["retry_after_ms"] = int(retry[r])
+        if dedups is not None and int(dedups[r]):
+            rep["dedup"] = True  # the version-4 record flag's JSON twin
         if traces is not None and int(traces[r]):
             rep["trace"] = int(traces[r])
         return rep
@@ -916,10 +965,11 @@ class GatewayServer:
                 # waves overlap: concurrent handle_frame threads resolve
                 # out of submit order under the continuous scheduler
                 outcomes, seqs = self.backend.ask_many(
-                    st.ents, st.vals, st.ctxs, with_seqs=True)
+                    st.ents, st.vals, st.ctxs, with_seqs=True,
+                    keys=st.ask_keys)
             else:
                 outcomes = self._backend_ask_many(st.ents, st.vals,
-                                                  st.ctxs)
+                                                  st.ctxs, st.ask_keys)
             dt = time.perf_counter() - t0
         return self._serve_resolve(st, outcomes, dt, seqs)
 
@@ -1049,10 +1099,62 @@ class GatewayServer:
                 keep = ~np.isin(serve, replica_rows)
                 serve = serve[keep]
 
+        # ---- journaled reply-cache dedup (ISSUE 20): ONE vectorized
+        # check per window, strictly AFTER the admission charge (a shed
+        # retry is a shed, never a cached hit) — duplicate ids replay
+        # the cached reply and never re-enter the ask wave; same-window
+        # duplicates alias their source row's reply at resolve; a
+        # duplicate of a still-in-flight first attempt is a typed shed.
+        dd = self.dedup
+        if dd is not None and len(serve):
+            keys: List[Optional[Tuple[str, int]]] = []
+            for i in serve:
+                if aux is not None and int(i) in aux.raw_ids:
+                    keys.append(None)  # non-wire JSON ids never dedup
+                else:
+                    keys.append((tenants[i].decode("utf-8", "replace"),
+                                 int(st.ids[i])))
+            with self._dedup_lock:
+                verdicts = dd.begin(keys)
+            dedups = st.dedup = np.zeros((n,), np.uint8)
+            keep = np.ones(len(serve), bool)
+            for j, v in enumerate(verdicts):
+                kind = v[0]
+                i = int(serve[j])
+                if kind == "hit":
+                    status[i] = np.uint8(v[1])
+                    value[i] = v[2]
+                    if v[3]:
+                        reason[i] = v[3]
+                    dedups[i] = 1
+                    keep[j] = False
+                    self._note(st, tenants[i], "ok"
+                               if v[1] == frames.ST_OK else "error")
+                elif kind == "alias":
+                    st.dedup_alias[i] = int(serve[v[1]])
+                    dedups[i] = 1
+                    keep[j] = False
+                elif kind == "inflight":
+                    status[i] = frames.ST_SHED
+                    reason[i] = DUPLICATE_INFLIGHT.encode("utf-8") \
+                        [:frames.REASON_BYTES]
+                    retry[i] = 20  # first attempt resolves within a wave
+                    dedups[i] = 1
+                    keep[j] = False
+                    self._note(st, tenants[i], "reject")
+                elif kind == "miss":
+                    st.dedup_keys[i] = keys[j]
+            serve = serve[keep]
+
         st.serve = serve
         st.vals = np.where(ops[serve] == frames.OP_ADD,
                            rec["value"][serve].astype(np.float64), 0.0)
         st.ents = [entities[i].decode("utf-8") for i in serve]
+        if st.dedup_keys:
+            # aligned (tenant, id) per ask-wave member: rides the wave
+            # into the entity journal's group commit (commit-before-ack
+            # covers the reply cache) via ask_many(keys=)
+            st.ask_keys = [st.dedup_keys.get(int(i)) for i in serve]
         st.ctxs = None
         if roots:  # each sampled request's ctx rides with its ask
             st.ctxs = [roots[i].ctx if i in roots else None
@@ -1069,6 +1171,7 @@ class GatewayServer:
         status, reason, value, retry = st.status, st.reason, st.value, \
             st.retry
         cache = self.replica_cache
+        dd = self.dedup
         if len(st.serve):
             pool_noted = False
             wave_totals: Dict[str, float] = {}
@@ -1076,6 +1179,7 @@ class GatewayServer:
             for j, (i, outc, ent) in enumerate(
                     zip(st.serve, outcomes, st.ents)):
                 t = st.tenants[i]
+                key = st.dedup_keys.get(int(i))
                 if isinstance(outc, AskPoolExhausted):
                     if not pool_noted:
                         self.admission.note_ask_pool_exhausted()
@@ -1084,16 +1188,34 @@ class GatewayServer:
                     reason[i] = b"ask_pool_exhausted"
                     retry[i] = int(self.admission.cooldown_s * 1e3)
                     self._note(st, t, "reject")
+                    if key is not None:  # nothing applied: retry fresh
+                        with self._dedup_lock:
+                            dd.release(key)
                 elif isinstance(outc, TimeoutError):
                     reason[i] = b"timeout"
                     self._note(st, t, "timeout", dt)
+                    if key is not None:
+                        # ambiguous — the apply may have landed without
+                        # latching a reply; cache the timeout so the id
+                        # stays at-most-once (see dedup module docstring)
+                        with self._dedup_lock:
+                            dd.record(key, frames.ST_ERROR, 0.0,
+                                      b"timeout")
                 elif isinstance(outc, BaseException):
                     self._set_reason(st, i, f"fault:{type(outc).__name__}")
                     self._note(st, t, "error", dt)
+                    if key is not None:  # typed fault: nothing applied
+                        with self._dedup_lock:
+                            dd.release(key)
                 else:
                     status[i] = frames.ST_OK
                     value[i] = outc
                     self._note(st, t, "ok", dt)
+                    if key is not None:
+                        # journal already group-committed this reply
+                        # (commit-before-ack); now the live table
+                        with self._dedup_lock:
+                            dd.record(key, frames.ST_OK, float(outc))
                     # last ok outcome per entity wins: rows are in wave
                     # linearization order, so this IS the post-wave total
                     wave_totals[ent] = float(outc)
@@ -1107,6 +1229,19 @@ class GatewayServer:
                     cache.publish_wave(wave_totals)
                 else:
                     self._publish_filtered(wave_totals, wave_seqs)
+
+        # same-window duplicates: copy the source row's resolved reply
+        # (byte-identical on both encodings) — after the wave resolved
+        # the source, before SLO rounds and span finishes
+        for i, src in st.dedup_alias.items():
+            status[i] = status[src]
+            reason[i] = reason[src]
+            value[i] = value[src]
+            retry[i] = retry[src]
+            stt = int(status[i])
+            self._note(st, st.tenants[i],
+                       "ok" if stt == frames.ST_OK else
+                       ("reject" if stt == frames.ST_SHED else "error"))
 
         for t, outs in st.slo_outcomes.items():
             self.slo.record_many(t.decode("utf-8"), outs, st.slo_lat[t],
@@ -1123,7 +1258,7 @@ class GatewayServer:
                 sp.finish(status=st_names.get(int(status[i]), "error"),
                           **({"reason": rsn} if rsn else {}))
         return st.ids, status, reason, value, retry, st.traces, \
-            st.step_lag
+            st.step_lag, st.dedup
 
     def _publish_filtered(self, totals: Dict[str, float],
                           wave_seqs: Dict[str, int]) -> None:
@@ -1164,12 +1299,17 @@ class GatewayServer:
 
     def _backend_ask_many(self, entity_ids: List[str],
                           values: np.ndarray,
-                          ctxs: Optional[List[Any]] = None) -> List[Any]:
+                          ctxs: Optional[List[Any]] = None,
+                          keys: Optional[List[Any]] = None) -> List[Any]:
         asker = getattr(self.backend, "ask_many", None)
         if asker is not None:
             # ctxs exist only when tracing is on; backends that batch
             # (RegionBackend) accept them, and the fallback loop below
-            # pins each member's ctx as the ambient one per ask
+            # pins each member's ctx as the ambient one per ask; keys
+            # (ISSUE 20) ride only when dedup staged some — backends
+            # without the kwarg never see it
+            if keys is not None:
+                return asker(entity_ids, values, ctxs, keys=keys)
             return asker(entity_ids, values) if ctxs is None \
                 else asker(entity_ids, values, ctxs)
         out: List[Any] = []
@@ -1204,6 +1344,9 @@ class GatewayServer:
                 batcher = getattr(self.backend, "batcher", None)
                 if batcher is not None:
                     data["ask_batch"] = batcher.stats()
+                if self.dedup is not None:
+                    with self._dedup_lock:
+                        data["dedup"] = self.dedup.stats()
                 return {"id": rid, "status": "ok", "data": data}
             if op == "checkpoint":
                 return {"id": rid, "status": "ok",
@@ -1250,17 +1393,35 @@ class GatewayClient:
     """Blocking raw-socket client (tests / load generators / example).
     One request in flight per connection; `request` returns the decoded
     reply dict. `request_retry` reconnects through server restarts — the
-    chaos legs' client behavior."""
+    chaos legs' client behavior.
+
+    Idempotent sessions (ISSUE 20): every request id is
+    `(session << 24) | seq` — a random per-client session tag over a
+    monotone sequence — so ids are unique ACROSS clients and reconnects,
+    and `request_retry` resends the SAME id on every attempt. Against a
+    dedup-enabled gateway that makes a retried effect exactly-once: the
+    server replays the cached reply instead of re-applying. The id is
+    masked positive-int64 (the wire's `>i8`), leaving an effective
+    39-bit session tag over a 24-bit sequence."""
 
     def __init__(self, host: str, port: int, timeout: float = 15.0,
-                 max_frame: int = DEFAULT_MAX_FRAME):
+                 max_frame: int = DEFAULT_MAX_FRAME,
+                 session: Optional[int] = None):
         self.host = host
         self.port = port
         self.timeout = timeout
         self.max_frame = max_frame
         self._sock: Optional[socket.socket] = None
         self._reader = FrameReader(max_frame)
+        self.session = random.getrandbits(64) if session is None \
+            else int(session)
         self._seq = 0
+
+    def _next_id(self) -> int:
+        """Mint the next idempotent request id for this session."""
+        self._seq += 1
+        return ((self.session << 24) | (self._seq & 0xFFFFFF)) \
+            & 0x7FFFFFFFFFFFFFFF
 
     def connect(self) -> None:
         self.close()
@@ -1279,11 +1440,16 @@ class GatewayClient:
 
     def request(self, tenant: str, entity: str, op: str,
                 value: float = 0.0) -> Dict[str, Any]:
+        req = {"id": self._next_id(), "tenant": tenant, "entity": entity,
+               "op": op, "value": value}
+        return self._request_raw(req)
+
+    def _request_raw(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        """Send a prebuilt request dict — `request_retry` resends the
+        SAME dict (same id) across reconnects, the idempotent half of
+        the exactly-once contract."""
         if self._sock is None:
             self.connect()
-        self._seq += 1
-        req = {"id": self._seq, "tenant": tenant, "entity": entity,
-               "op": op, "value": value}
         self._sock.sendall(encode_frame(req))
         while True:
             data = self._sock.recv(65536)
@@ -1303,8 +1469,7 @@ class GatewayClient:
             self.connect()
         ids, tenants, entities, ops, values = [], [], [], [], []
         for tenant, entity, op, val in requests:
-            self._seq += 1
-            ids.append(self._seq)
+            ids.append(self._next_id())
             tenants.append(tenant)
             entities.append(entity)
             ops.append(op)
@@ -1345,8 +1510,7 @@ class GatewayClient:
                 raise ValueError("empty window in pipelined request")
             ids, tenants, entities, ops, values = [], [], [], [], []
             for tenant, entity, op, val in win:
-                self._seq += 1
-                ids.append(self._seq)
+                ids.append(self._next_id())
                 tenants.append(tenant)
                 entities.append(entity)
                 ops.append(op)
@@ -1376,19 +1540,47 @@ class GatewayClient:
 
     def request_retry(self, tenant: str, entity: str, op: str,
                       value: float = 0.0, deadline_s: float = 60.0,
-                      pause_s: float = 0.2) -> Dict[str, Any]:
+                      pause_s: float = 0.2, max_backoff_s: float = 2.0,
+                      jitter: float = 0.25,
+                      retry_sheds: bool = False) -> Dict[str, Any]:
         """Retry through connection failures (server crash/restart) until
-        `deadline_s`. Shed replies are returned to the caller — backoff
-        on rejects is a POLICY, reconnection is plumbing."""
+        `deadline_s`, resending the SAME request id on every attempt
+        (idempotent session — a dedup-enabled gateway replays the cached
+        reply instead of re-applying). Attempts pace with exponential
+        backoff + jitter (`pattern/backoff.py`): `pause_s` is the floor,
+        `max_backoff_s` the cap. Shed replies are returned to the caller
+        (backoff on rejects is a POLICY, reconnection is plumbing) —
+        except `duplicate_inflight`, which only this client's own retry
+        can provoke, and sheds in general when `retry_sheds` is set.
+        The returned reply carries `attempts` and, when any attempt
+        failed, `last_error`."""
         deadline = time.monotonic() + deadline_s
-        last: Optional[Exception] = None
+        last: Optional[BaseException] = None
+        attempts = 0
+        req = {"id": self._next_id(), "tenant": tenant, "entity": entity,
+               "op": op, "value": value}
         while time.monotonic() < deadline:
+            attempts += 1
+            delay = backoff_delay(attempts - 1, pause_s, max_backoff_s,
+                                  jitter)
             try:
-                return self.request(tenant, entity, op, value)
+                rep = self._request_raw(req)
             except (OSError, ConnectionError, socket.timeout) as e:
                 last = e
                 self.close()
-                time.sleep(pause_s)
+                time.sleep(delay)
+                continue
+            if rep.get("status") == "shed" and \
+                    (retry_sheds or
+                     rep.get("reason") == DUPLICATE_INFLIGHT):
+                last = None
+                time.sleep(max(delay,
+                               rep.get("retry_after_ms", 0) / 1e3))
+                continue
+            rep["attempts"] = attempts
+            if last is not None:
+                rep["last_error"] = repr(last)
+            return rep
         raise TimeoutError(f"gateway unreachable for {deadline_s}s: {last!r}")
 
     def admin(self, op: str, value: float = 0.0) -> Dict[str, Any]:

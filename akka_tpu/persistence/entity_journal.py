@@ -15,7 +15,17 @@ FileJournal/TellJournal family shares, with the same torn-tail
 truncation on open (journal.repair_record_log). One record per wave:
 
     {"step": S, "events": [(entity_id, op, value), ...],
-     "snaps": {entity_id: total}}
+     "snaps": {entity_id: total},
+     "replies": [(tenant, request_id, status, value), ...]}
+
+`replies` (ISSUE 20) is the gateway's dedup frontier: the ok reply of
+every idempotent-session request resolved in this wave, committed in
+the SAME record as the events it acknowledges — commit-before-ack now
+covers the reply cache, so kill -9 + restore replays the frontier
+(`replies()`) and a post-restore retry returns the cached reply instead
+of re-applying. The live fold keeps the newest `max_replies` of them in
+arrival order (the gateway's per-tenant windows re-bound them on
+rehydrate). Absent on pre-ISSUE-20 records — replay tolerates both.
 
 `events` are deltas in wave-linearization order; `snaps` are per-entity
 snapshots piggybacked into the SAME write whenever an entity has
@@ -71,20 +81,24 @@ class EntityJournal:
 
     def __init__(self, path: str, flight_recorder: Optional[Any] = None,
                  fsync_every_n: int = 1, snapshot_every: int = 64,
-                 compact_every: int = 8192, registry=None):
+                 compact_every: int = 8192, registry=None,
+                 max_replies: int = 1 << 16):
         self.path = path
         self.flight_recorder = flight_recorder
         self.fsync_every_n = max(1, int(fsync_every_n))
         self.snapshot_every = max(1, int(snapshot_every))
         self.compact_every = max(self.snapshot_every, int(compact_every))
+        self.max_replies = max(1, int(max_replies))
         self._since_fsync = 0
         self._events_since_compact = 0
         self._lock = threading.Lock()
         self._totals: Dict[str, float] = {}
         self._counts: Dict[str, int] = {}  # events since entity's last snap
+        # insertion-ordered dedup frontier: (tenant, id) -> (status, value)
+        self._replies: Dict[Tuple[str, int], Tuple[int, float]] = {}
         self._last_step = 0
         self._stats = {"waves": 0, "events": 0, "snaps": 0, "fsyncs": 0,
-                       "compactions": 0}
+                       "compactions": 0, "replies": 0}
         self._h_batch = self._h_fsync = self._h_replay = None
         self._registry = registry
         if registry is not None:
@@ -132,18 +146,41 @@ class EntityJournal:
         for eid, total in (rec.get("snaps") or {}).items():
             self._totals[eid] = float(total)
             self._counts[eid] = 0
+        for tenant, rid, status, value in rec.get("replies", ()):
+            self._fold_reply((str(tenant), int(rid)),
+                             int(status), float(value))
+
+    def _fold_reply(self, key: Tuple[str, int], status: int,
+                    value: float) -> None:
+        # re-insert moves the key to the newest end (dict order)
+        self._replies.pop(key, None)
+        self._replies[key] = (status, value)
+        while len(self._replies) > self.max_replies:
+            del self._replies[next(iter(self._replies))]
 
     # -- write side ----------------------------------------------------------
     def append_wave(self, step: int,
                     events: Sequence[Tuple[str, int, float]],
-                    per_event_fsync: bool = False) -> int:
+                    per_event_fsync: bool = False,
+                    replies: Optional[
+                        Sequence[Tuple[str, int, int, float]]] = None
+                    ) -> int:
         """Group-commit one ask wave's ok events: fold them into the live
         totals, piggyback a snapshot for every entity that crossed
         `snapshot_every` events, and write it all as ONE record. Returns
         the number of events committed. `per_event_fsync` is the bench's
-        degenerate leg: one record + one fsync per event."""
+        degenerate leg: one record + one fsync per event.
+
+        `replies` (ISSUE 20): the wave's resolved idempotent-session
+        replies `(tenant, request_id, status, value)`, committed in the
+        same record — the dedup frontier rides the exact fsync that
+        covers the events it acknowledges. A wave of pure gets has
+        replies but no nonzero events; it still writes a record so the
+        reply cache survives a crash."""
         events = [(str(e), int(op), float(v)) for e, op, v in events]
-        if not events:
+        replies = [(str(t), int(r), int(st), float(v))
+                   for t, r, st, v in (replies or ())]
+        if not events and not replies:
             return 0
         with self._lock:
             if self._fh is None:
@@ -157,21 +194,30 @@ class EntityJournal:
                     snaps[eid] = self._totals[eid]
                     n = 0
                 self._counts[eid] = n
+            for tenant, rid, status, value in replies:
+                self._fold_reply((tenant, rid), status, value)
             if per_event_fsync:
                 for eid, op, value in events:
                     self._write_record({"step": int(step),
                                         "events": [(eid, op, value)],
                                         "snaps": {}})
                     self._fsync_locked()
+                if replies:
+                    self._write_record({"step": int(step), "events": [],
+                                        "snaps": {}, "replies": replies})
+                    self._fsync_locked()
             else:
-                self._write_record({"step": int(step), "events": events,
-                                    "snaps": snaps})
+                rec = {"step": int(step), "events": events, "snaps": snaps}
+                if replies:
+                    rec["replies"] = replies
+                self._write_record(rec)
                 self._since_fsync += 1
                 if self._since_fsync >= self.fsync_every_n:
                     self._fsync_locked()
             self._stats["waves"] += 1
             self._stats["events"] += len(events)
             self._stats["snaps"] += len(snaps)
+            self._stats["replies"] += len(replies)
             self._events_since_compact += len(events)
             need_compact = self._events_since_compact >= self.compact_every
         step_stamp = self._registry.step if self._registry else None
@@ -222,6 +268,14 @@ class EntityJournal:
         (empty for a journal that was born in this process)."""
         return dict(self._replayed_events)
 
+    def replies(self) -> List[Tuple[str, int, int, float]]:
+        """The durable dedup frontier in arrival order:
+        `(tenant, request_id, status, value)` per remembered reply —
+        what the gateway feeds `ReplyCacheTable.load` on restore."""
+        with self._lock:
+            return [(t, r, st, v)
+                    for (t, r), (st, v) in self._replies.items()]
+
     def records(self) -> List[Dict[str, Any]]:
         with self._lock:
             if self._fh is not None:
@@ -232,6 +286,7 @@ class EntityJournal:
         with self._lock:
             out = {k: float(v) for k, v in self._stats.items()}
             out["entities"] = float(len(self._totals))
+            out["cached_replies"] = float(len(self._replies))
             out["bytes"] = float(os.path.getsize(self.path)
                                  if os.path.exists(self.path) else 0)
         return out
@@ -247,6 +302,9 @@ class EntityJournal:
                 raise ValueError("EntityJournal is closed")
             rec = {"step": int(self._last_step), "events": [],
                    "snaps": dict(self._totals)}
+            if self._replies:
+                rec["replies"] = [(t, r, st, v) for (t, r), (st, v)
+                                  in self._replies.items()]
             blob = pickle.dumps(rec, protocol=4)
             tmp = self.path + ".tmp"
             with open(tmp, "wb") as f:

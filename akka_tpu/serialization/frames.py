@@ -49,6 +49,14 @@ emitted ONLY when some record in the wave was actually replica-served,
 mirroring the version-2 discipline: a gateway without a replica cache
 (or a wave with no replica hits) never changes the wire.
 
+Dedup reply record (version 4, 66 bytes — ISSUE 20): version 3's
+fields plus a trailing `dedup u1` — 1 marks a reply served from the
+journaled reply cache (a duplicate request id short-circuited before
+the ask wave; the value/status are the FIRST attempt's, replayed
+verbatim). Version 4 is emitted ONLY when some record in the wave was
+actually dedup-served, same discipline as versions 2/3: a gateway
+without a dedup table never changes the wire.
+
 String fields are NUL-padded UTF-8; a reason longer than 32 bytes is
 truncated (every typed gateway reason fits). A batch of one is the solo
 ask — bit-identical semantics to its JSON twin, tested in
@@ -70,11 +78,12 @@ import numpy as np
 from .codec import _U32
 
 __all__ = ["MAGIC", "VERSION", "VERSION_TRACED", "VERSION_REPLICA",
+           "VERSION_DEDUP",
            "KIND_REQUEST",
            "KIND_REPLY", "OP_GET", "OP_ADD", "OP_NAMES", "OP_CODES",
            "ST_OK", "ST_SHED", "ST_ERROR",
            "REQUEST_DTYPE", "REPLY_DTYPE", "REPLY_DTYPE_TRACED",
-           "REPLY_DTYPE_REPLICA",
+           "REPLY_DTYPE_REPLICA", "REPLY_DTYPE_DEDUP",
            "DEFAULT_MAX_FRAME",
            "FrameFormatError", "is_binary", "frame",
            "encode_request_batch", "decode_request_batch",
@@ -86,6 +95,7 @@ MAGIC = 0xAB
 VERSION = 1
 VERSION_TRACED = 2  # replies only: VERSION layout + trailing trace u64
 VERSION_REPLICA = 3  # replies only: VERSION_TRACED layout + step_lag i32
+VERSION_DEDUP = 4  # replies only: VERSION_REPLICA layout + dedup u1
 KIND_REQUEST = 0
 KIND_REPLY = 1
 
@@ -129,6 +139,12 @@ REPLY_DTYPE_TRACED = np.dtype(REPLY_DTYPE.descr + [("trace", ">u8")])
 # device steps behind the authoritative state; -1 <=> wave path
 REPLY_DTYPE_REPLICA = np.dtype(REPLY_DTYPE_TRACED.descr
                                + [("step_lag", ">i4")])
+
+# version-4 reply record: version 3 + the reply-cache dedup marker
+# (ISSUE 20): dedup == 1 <=> this reply was replayed from the journaled
+# reply cache (the request id was a duplicate; the effect applied once)
+REPLY_DTYPE_DEDUP = np.dtype(REPLY_DTYPE_REPLICA.descr
+                             + [("dedup", "u1")])
 
 
 class FrameFormatError(ValueError):
@@ -266,7 +282,8 @@ def encode_reply_batch(ids: np.ndarray, statuses: np.ndarray,
                        reasons: np.ndarray, values: np.ndarray,
                        retry_after_ms: np.ndarray,
                        traces: Any = None,
-                       step_lags: Any = None) -> bytes:
+                       step_lags: Any = None,
+                       dedups: Any = None) -> bytes:
     """Encode a whole reply wave in one vectorized pass (columns in,
     bytes out — the readback twin of decode_request_batch).
 
@@ -279,12 +296,21 @@ def encode_reply_batch(ids: np.ndarray, statuses: np.ndarray,
     (−1 = authoritative, ≥ 0 = replica-served at that step lag). When
     any row was replica-served the wave is version 3 (trace column
     included, zeros if untraced); otherwise the column is dropped and
-    the version-2/1 rules above apply unchanged."""
+    the version-2/1 rules above apply unchanged.
+
+    `dedups` (ISSUE 20): optional aligned u1 dedup-marker column (1 =
+    served from the reply cache). When any row was dedup-served the
+    wave is version 4 (trace/step_lag columns included, zeros/−1 when
+    inert); otherwise the column is dropped and the version-3/2/1 rules
+    above apply unchanged."""
     n = len(ids)
     traced = traces is not None and bool(np.any(np.asarray(traces)))
     replica = step_lags is not None and \
         bool(np.any(np.asarray(step_lags) >= 0))
-    if replica:
+    deduped = dedups is not None and bool(np.any(np.asarray(dedups)))
+    if deduped:
+        rec = np.zeros((n,), REPLY_DTYPE_DEDUP)
+    elif replica:
         rec = np.zeros((n,), REPLY_DTYPE_REPLICA)
     else:
         rec = np.zeros((n,), REPLY_DTYPE_TRACED if traced else REPLY_DTYPE)
@@ -293,6 +319,13 @@ def encode_reply_batch(ids: np.ndarray, statuses: np.ndarray,
     rec["reason"] = reasons
     rec["value"] = values
     rec["retry_after_ms"] = retry_after_ms
+    if deduped:
+        if traced:
+            rec["trace"] = np.asarray(traces, np.uint64)
+        rec["step_lag"] = (np.asarray(step_lags, np.int32)
+                           if step_lags is not None else -1)
+        rec["dedup"] = np.asarray(dedups, np.uint8)
+        return _header(KIND_REPLY, n, VERSION_DEDUP) + rec.tobytes()
     if replica:
         if traced:
             rec["trace"] = np.asarray(traces, np.uint64)
@@ -309,6 +342,9 @@ def decode_reply_batch(body: bytes,
     """Decode a reply wave to its record columns (client half). Accepts
     both reply versions: 1 (53B records) and 2 (61B traced records) —
     the record array's dtype tells the caller which it got."""
+    if len(body) >= 2 and body[1] == VERSION_DEDUP:
+        return _decode_records(body, KIND_REPLY, REPLY_DTYPE_DEDUP,
+                               max_frame, VERSION_DEDUP)
     if len(body) >= 2 and body[1] == VERSION_REPLICA:
         return _decode_records(body, KIND_REPLY, REPLY_DTYPE_REPLICA,
                                max_frame, VERSION_REPLICA)
@@ -337,6 +373,8 @@ def reply_to_dict(rec) -> Dict[str, Any]:
     if "step_lag" in (rec.dtype.names or ()) and int(rec["step_lag"]) >= 0:
         out["replica"] = True
         out["step_lag"] = int(rec["step_lag"])
+    if "dedup" in (rec.dtype.names or ()) and int(rec["dedup"]):
+        out["dedup"] = True
     return out
 
 

@@ -95,7 +95,7 @@ class BatchAsk:
     __slots__ = ("shard", "index", "message", "steps", "max_extra_steps",
                  "slot", "prow", "row", "start", "outcome", "future",
                  "t_submit", "trace", "t_stage", "step_stage", "wave",
-                 "was_deferred", "resolve_seq")
+                 "was_deferred", "resolve_seq", "dedup_key")
 
     def __init__(self, shard: int, index: int, message: Any,
                  steps: int = 2, max_extra_steps: int = 8,
@@ -124,6 +124,11 @@ class BatchAsk:
         self.wave = None
         self.was_deferred = False
         self.resolve_seq = 0
+        # idempotent-session dedup key (ISSUE 20): the gateway's
+        # (tenant, request_id) for this member, or None. Rides the ask to
+        # the journal commit sites so the wave's group commit records the
+        # reply under the same fsync as the events it acknowledges.
+        self.dedup_key = None
 
 
 def _reset_batch_latches(region, slots: Sequence[int]) -> None:
@@ -356,7 +361,8 @@ def execute_ask_batch(region, batch: Sequence[BatchAsk]) -> None:
             with wspan.child("wave.journal", wave_id=wave_id,
                              n_events=len(ok_resolved)):
                 region._commit_entity_events(
-                    [(a.shard, a.index, a.message) for a in ok_resolved])
+                    [(a.shard, a.index, a.message, a.dedup_key, a.outcome)
+                     for a in ok_resolved])
         if tracer is not None:
             tracer.emit("wave.resolve", wspan.ctx, t0=t_res0,
                         t1=time.monotonic(), wave_id=wave_id,
@@ -702,8 +708,8 @@ class ContinuousWaveScheduler:
                     with h.wspan.child("wave.journal", wave_id=h.wave_id,
                                        n_events=len(h.ok)):
                         region._commit_entity_events(
-                            [(a.shard, a.index, a.message)
-                             for a in h.ok])
+                            [(a.shard, a.index, a.message, a.dedup_key,
+                              a.outcome) for a in h.ok])
                 finished.append(h)
         for h in finished:
             self._complete(h)
@@ -822,7 +828,8 @@ class ContinuousWaveScheduler:
                 if h.ok and getattr(self.region, "_entity_journal",
                                     None) is not None:
                     self.region._commit_entity_events(
-                        [(a.shard, a.index, a.message) for a in h.ok])
+                        [(a.shard, a.index, a.message, a.dedup_key,
+                          a.outcome) for a in h.ok])
         for h in leftovers:
             for a in h.batch:
                 if a.outcome is None:
@@ -897,7 +904,8 @@ class AskBatcher:
     # ------------------------------------------------------------- submit
     def submit(self, shard: int, index: int, message: Any,
                steps: Optional[int] = None,
-               max_extra_steps: Optional[int] = None) -> Future:
+               max_extra_steps: Optional[int] = None,
+               dedup_key=None) -> Future:
         a = BatchAsk(int(shard), int(index), message,
                      self.steps if steps is None else int(steps),
                      self.max_extra_steps if max_extra_steps is None
@@ -907,6 +915,7 @@ class AskBatcher:
                      # request is unsampled — the one read the quiet path
                      # pays)
                      trace=current_ctx())
+        a.dedup_key = dedup_key
         a.future = Future()
         a.t_submit = time.perf_counter()
         with self._lock:
@@ -924,15 +933,17 @@ class AskBatcher:
 
     def ask(self, shard: int, index: int, message: Any,
             steps: Optional[int] = None,
-            max_extra_steps: Optional[int] = None):
+            max_extra_steps: Optional[int] = None,
+            dedup_key=None):
         """Submit and wait: returns the reply payload or raises the
         per-ask exception (TimeoutError / AskPoolExhausted / ...)."""
         return self.submit(shard, index, message, steps,
-                           max_extra_steps).result()
+                           max_extra_steps, dedup_key=dedup_key).result()
 
     def ask_many(self, requests: Sequence[Any],
                  ctxs: Optional[Sequence[Any]] = None,
-                 with_seqs: bool = False):
+                 with_seqs: bool = False,
+                 keys: Optional[Sequence[Any]] = None):
         """Columnar wave entry (ISSUE 11): `requests` is a sequence of
         `(shard, index, message)` decoded from one binary window.
         Returns outcomes aligned with `requests` — the reply payload or
@@ -959,7 +970,11 @@ class AskBatcher:
         gateway uses to keep replica publishes per-entity monotone when
         resolve boundaries complete out of submit order; in serialized
         mode the seqs are None — waves resolve in submit order there, so
-        publish order needs no filter (bit-parity with PR 15)."""
+        publish order needs no filter (bit-parity with PR 15).
+
+        `keys` (ISSUE 20): optional aligned dedup keys — the gateway's
+        (tenant, request_id) per member, pinned to the BatchAsk so the
+        journal commit sites can record the reply with the wave."""
         reqs = list(requests)
         if not reqs:
             return ([], None) if with_seqs else []
@@ -969,6 +984,9 @@ class AskBatcher:
             if ctxs is not None:
                 for a, c in zip(batch, ctxs):
                     a.trace = c
+            if keys is not None:
+                for a, k in zip(batch, keys):
+                    a.dedup_key = k
             if len(batch) == 1:
                 # a wave of one rides the dispatcher window exactly as
                 # in serialized mode, so concurrent solo asks coalesce
@@ -1014,7 +1032,8 @@ class AskBatcher:
             if ctxs is not None and ctxs[0] is not None:
                 tok = set_ctx(ctxs[0])  # submit() snapshots it per ask
             try:
-                out = [self.ask(s, i, m)]
+                out = [self.ask(s, i, m, dedup_key=keys[0]
+                                if keys is not None else None)]
             except BaseException as e:  # noqa: BLE001 — outcome convention
                 out = [e]
             finally:
@@ -1029,6 +1048,9 @@ class AskBatcher:
         if ctxs is not None:
             for a, c in zip(batch, ctxs):
                 a.trace = c
+        if keys is not None:
+            for a, k in zip(batch, keys):
+                a.dedup_key = k
         region = self.region
         t0 = time.perf_counter()
         # waves larger than the promise pool ride consecutive sub-batches
@@ -1070,7 +1092,8 @@ class AskBatcher:
     def ask_many_async(self, requests: Sequence[Any],
                        ctxs: Optional[Sequence[Any]] = None,
                        on_done: Optional[Callable[
-                           [List[Any], List[int]], Any]] = None) -> None:
+                           [List[Any], List[int]], Any]] = None,
+                       keys: Optional[Sequence[Any]] = None) -> None:
         """Continuous-mode async wave entry (ISSUE 16): stage the wave
         NOW on the calling thread (preserving per-connection submit
         order — staging order IS the linearization order) and return
@@ -1091,6 +1114,9 @@ class AskBatcher:
         if ctxs is not None:
             for a, c in zip(batch, ctxs):
                 a.trace = c
+        if keys is not None:
+            for a, k in zip(batch, keys):
+                a.dedup_key = k
         if not batch:
             if on_done is not None:
                 on_done([], [])

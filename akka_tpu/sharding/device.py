@@ -608,24 +608,40 @@ class DeviceShardRegion:
         no-op events (a gateway get is add(0) — no durable effect), and
         append everything as one record. The fsync (per fsync_every_n
         waves) happens HERE, before any ack leaves — zero lost acked
-        writes across a machine crash, not just a process kill."""
+        writes across a machine crash, not just a process kill.
+
+        Members are `(shard, index, message)` or — when the gateway runs
+        idempotent-session dedup (ISSUE 20) — `(shard, index, message,
+        dedup_key, outcome)`: keyed members additionally record their ok
+        reply `(tenant, id, status, value)` in the SAME record, so the
+        dedup frontier is covered by the exact fsync that covers the
+        events it acknowledges (commit-before-ack extends to the reply
+        cache). A wave of keyed gets writes a replies-only record."""
         ej = self._entity_journal
         if ej is None:
             return
         from ..persistence.entity_journal import OP_ADD
+        from ..serialization.frames import ST_OK
         events = []
+        replies = []
         with self._lock:
-            for shard, index, message in resolved:
+            for member in resolved:
+                shard, index, message = member[0], member[1], member[2]
                 body = np.asarray(message, np.float64).reshape(-1)
                 value = float(body[0]) if body.size else 0.0
+                if len(member) >= 5 and member[3] is not None:
+                    out = np.asarray(member[4], np.float64).reshape(-1)
+                    replies.append((member[3][0], member[3][1], ST_OK,
+                                    float(out[0]) if out.size else 0.0))
                 if value == 0.0:
                     continue
                 eid = self._rev[shard].get(index)
                 if eid is not None:
                     events.append((eid, OP_ADD, value))
-        if events:
+        if events or replies:
             ej.append_wave(int(self.system._host_step), events,
-                           per_event_fsync=self._per_event_fsync)
+                           per_event_fsync=self._per_event_fsync,
+                           replies=replies)
 
     def _respawn_remembered(self) -> None:
         """Re-host every remembered entity with zero client traffic:

@@ -1960,6 +1960,115 @@ def bench_gateway_continuous_ab(region, per_leg: int = 384):
                            for r in serialized + continuous))}
 
 
+def bench_gateway_dedup_ab(region, per_leg: int = 384):
+    """Reply-cache dedup A/B (ISSUE 20 acceptance): the SAME 64-client
+    threaded batched leg (90/10 add/get over 16 entities through
+    handle_frame, admission wide open) with the journaled reply cache
+    off vs on. Every request id is UNIQUE — this measures the cache's
+    overhead on the hot non-duplicate path (one vectorized begin() per
+    serve window + one record() per ok outcome), not its hit path.
+    Acceptance: dedup-on req/s >= 0.95x dedup-off at equal admission.
+
+    A short replay coda after the ON leg resends already-acked ids and
+    checks they come back `dedup:true` WITHOUT re-applying — proof the
+    measured leg exercised a live cache, not a disabled one."""
+    import threading as _threading
+
+    from akka_tpu.gateway import (AdmissionController, GatewayServer,
+                                  RegionBackend, ReplyCacheTable,
+                                  SloTracker)
+
+    def leg(dedup_on: bool, clients: int = 64, record: bool = True):
+        base = RegionBackend(region, batch=False).sum_all()
+        backend = RegionBackend(region, max_batch=64)
+        adm = AdmissionController(rate=1e9, burst=1e9)
+        dd = ReplyCacheTable(window=4096) if dedup_on else None
+        srv = GatewayServer(None, backend, adm,
+                            SloTracker(target_p50_ms=50.0,
+                                       target_p99_ms=250.0), dedup=dd)
+        per_client = max(6, per_leg // clients)
+        tag = 1_000_000 if dedup_on else 2_000_000  # ids unique per leg
+        not_ok = []
+        acked = [0.0] * clients
+        last_req = [None] * clients
+
+        def worker(w: int):
+            tot = 0.0
+            for i in range(per_client):
+                op = "get" if i % 10 == 9 else "add"  # 90/10 add/get
+                val = float(i % 5 + 1)
+                req = {"id": tag + w * per_client + i,
+                       "tenant": f"t{w % 4}",
+                       "entity": f"dd-{(w + i) % 16}", "op": op,
+                       "value": val}
+                rep = json.loads(
+                    srv.handle_frame(json.dumps(req).encode()))
+                if rep["status"] != "ok":
+                    not_ok.append(rep["status"])
+                else:
+                    if op == "add":
+                        tot += val
+                        last_req[w] = req
+            acked[w] = tot
+
+        threads = [_threading.Thread(target=worker, args=(w,))
+                   for w in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        n = per_client * clients
+        total = backend.sum_all()
+        # admission snapshot BEFORE the replay coda: the coda's resends
+        # charge the bucket too (dedup is strictly post-admission)
+        n_admitted, n_rejected = adm.admitted, adm.rejected
+        replays = 0
+        if dedup_on:
+            # replay coda: acked ids must short-circuit from the cache
+            for req in [r for r in last_req if r is not None][:8]:
+                rep = json.loads(
+                    srv.handle_frame(json.dumps(req).encode()))
+                if rep.get("dedup") and rep["status"] == "ok":
+                    replays += 1
+        conserved = abs(backend.sum_all() - base - sum(acked)) < 1e-6
+        backend.close()
+        if not record:
+            return None
+        row = {"mode": "dedup_on" if dedup_on else "dedup_off",
+               "clients": clients, "requests": n,
+               "wall_s": round(dt, 3), "req_per_sec": round(n / dt, 1),
+               "not_ok": len(not_ok), "admitted": n_admitted,
+               "rejected": n_rejected,
+               "conserved": conserved and abs(total - base - sum(acked))
+               < 1e-6}
+        if dedup_on:
+            row["dedup"] = dd.stats()
+            row["replayed_no_reapply"] = replays
+        try:
+            row["host_loadavg"] = round(os.getloadavg()[0], 2)
+        except OSError:
+            pass
+        return row
+
+    leg(False, record=False)  # unrecorded warm-up (shapes compile here)
+    off = leg(False)
+    on = leg(True)
+    ratio = round(on["req_per_sec"] / max(off["req_per_sec"], 1e-9), 4)
+    equal_admission = (off["admitted"] == on["admitted"]
+                       and off["rejected"] == on["rejected"] == 0
+                       and off["not_ok"] == on["not_ok"] == 0)
+    return {"dedup_off": off, "dedup_on": on,
+            "req_per_sec_ratio": ratio,
+            "equal_admission": equal_admission,
+            "replayed_no_reapply": on["replayed_no_reapply"],
+            "conserved": off["conserved"] and on["conserved"],
+            "ok": (ratio >= 0.95 and equal_admission
+                   and on["replayed_no_reapply"] > 0
+                   and off["conserved"] and on["conserved"])}
+
+
 def bench_c1m_frontdoor(n_conns: int = 256, n_tenants: int = 20000,
                         per_conn: int = 16):
     """c1m-frontdoor: the C1M front-door transport A/B (ISSUE 18) — the
@@ -1994,6 +2103,7 @@ def bench_c1m_frontdoor(n_conns: int = 256, n_tenants: int = 20000,
     from akka_tpu.gateway import (AdmissionController, GatewayServer,
                                   SloTracker)
     from akka_tpu.gateway.ingress import FrameReader, encode_frame
+    from akka_tpu.serialization import frames as _frames
 
     soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
     slack = 256  # jax, journals, listen sockets, stdio, selector fds
@@ -2005,17 +2115,37 @@ def bench_c1m_frontdoor(n_conns: int = 256, n_tenants: int = 20000,
                  "requested_conns": requested, "conns": n_conns,
                  "clamped": n_conns < requested}
 
-    def blobs_for(nc: int, req: int):
+    def blobs_for(nc: int, req: int, binary: bool = False,
+                  window: int = 8):
         # pre-encoded per-connection request blobs: identical bytes on
         # both legs; tenant ids scatter over n_tenants via coprime
-        # strides so the columnar table sees a wide population
+        # strides so the columnar table sees a wide population. Binary
+        # blobs pack the SAME logical requests into 0xAB request
+        # windows of `window` records (op code 99 is the binary twin of
+        # "frontdoor_noop": typed unknown_op AFTER the admission charge)
+        if binary:
+            out = []
+            for c in range(nc):
+                parts = []
+                for lo in range(0, req, window):
+                    ids = list(range(lo, min(lo + window, req)))
+                    parts.append(_frames.frame(
+                        _frames.encode_request_batch(
+                            ids,
+                            [f"t{(c * 7919 + i * 104729) % n_tenants}"
+                             for i in ids],
+                            ["e"] * len(ids), [99] * len(ids),
+                            [0.0] * len(ids))))
+                out.append(b"".join(parts))
+            return out
         return [b"".join(
             encode_frame({"id": i,
                           "tenant": f"t{(c * 7919 + i * 104729) % n_tenants}",
                           "entity": "e", "op": "frontdoor_noop"})
             for i in range(req)) for c in range(nc)]
 
-    def leg(transport: str, nc: int, req: int, blobs, record: bool = True):
+    def leg(transport: str, nc: int, req: int, blobs,
+            record: bool = True, wire: str = "json"):
         system = None
         if transport == "stream":
             system = ActorSystem(f"c1m-{transport}-{nc}",
@@ -2079,7 +2209,12 @@ def bench_c1m_frontdoor(n_conns: int = 256, n_tenants: int = 20000,
                                 f"{transport}: server closed a "
                                 f"connection at {st['got']}/{req} replies")
                         for _body in st["reader"].feed_raw(data):
-                            st["got"] += 1
+                            if _body[:1] == b"\xab":
+                                # binary reply window: count its records
+                                st["got"] += len(
+                                    _frames.decode_reply_batch(_body))
+                            else:
+                                st["got"] += 1
                         if st["got"] >= req:
                             sel.unregister(s)
                             s.close()
@@ -2089,7 +2224,8 @@ def bench_c1m_frontdoor(n_conns: int = 256, n_tenants: int = 20000,
             if not record:
                 return None
             ast = adm.stats()
-            row = {"transport": transport, "conns": nc, "per_conn": req,
+            row = {"transport": transport, "wire": wire,
+                   "conns": nc, "per_conn": req,
                    "requests": total, "connect_s": round(connect_s, 3),
                    "wall_s": round(dt, 3),
                    "req_per_sec": round(total / dt, 1),
@@ -2121,12 +2257,30 @@ def bench_c1m_frontdoor(n_conns: int = 256, n_tenants: int = 20000,
     blobs = blobs_for(n_conns, per_conn)
     stream = leg("stream", n_conns, per_conn, blobs)
     evloop = leg("evloop", n_conns, per_conn, blobs)
+    # binary-window legs (ISSUE 20 satellite): the SAME logical traffic
+    # as 0xAB request windows — one columnar decode + one columnar
+    # reply encode per window instead of per-request JSON codec work
+    bblobs = blobs_for(n_conns, per_conn, binary=True)
+    bin_stream = leg("stream", n_conns, per_conn, bblobs, wire="binary")
+    bin_evloop = leg("evloop", n_conns, per_conn, bblobs, wire="binary")
     speedup = round(evloop["req_per_sec"]
                     / max(stream["req_per_sec"], 1e-9), 2)
     equal_admission = (stream["admitted"] == evloop["admitted"]
                        == n_conns * per_conn
                        and stream["rejected"] == evloop["rejected"] == 0)
+    bin_equal = (bin_stream["admitted"] == bin_evloop["admitted"]
+                 == n_conns * per_conn
+                 and bin_stream["rejected"] == bin_evloop["rejected"] == 0)
+    binary_window = {
+        "stream": bin_stream, "evloop": bin_evloop,
+        "window_records": 8,
+        "speedup": round(bin_evloop["req_per_sec"]
+                         / max(bin_stream["req_per_sec"], 1e-9), 2),
+        "vs_json_evloop": round(bin_evloop["req_per_sec"]
+                                / max(evloop["req_per_sec"], 1e-9), 2),
+        "equal_admission": bin_equal}
     return {"stream": stream, "evloop": evloop, "speedup": speedup,
+            "binary_window": binary_window,
             "fd_budget": fd_budget, "n_tenants": n_tenants,
             "equal_admission": equal_admission,
             "ok": speedup >= 2.0 and equal_admission}
@@ -2194,6 +2348,7 @@ def bench_gateway_slo(n_requests: int = 400, n_entities: int = 16):
     replica_ab = bench_gateway_replica_ab(region, per_leg=n_requests)
     durable_ab = bench_gateway_durable_ab(region, per_leg=n_requests)
     continuous_ab = bench_gateway_continuous_ab(region, per_leg=n_requests)
+    dedup_ab = bench_gateway_dedup_ab(region, per_leg=n_requests)
     return {"below_threshold": below, "overload": over,
             "entities_total": round(total, 1),
             "shed_working": over["rejects"] > 0 and below["rejects"] == 0,
@@ -2202,7 +2357,8 @@ def bench_gateway_slo(n_requests: int = 400, n_entities: int = 16):
             "ingest_ab": ingest_ab,
             "replica_ab": replica_ab,
             "durable_ab": durable_ab,
-            "continuous_ab": continuous_ab}
+            "continuous_ab": continuous_ab,
+            "dedup_ab": dedup_ab}
 
 
 def main() -> None:

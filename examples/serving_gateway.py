@@ -91,8 +91,15 @@ def cmd_serve(args: argparse.Namespace) -> int:
     slo = SloTracker(registry=system.metrics_registry,
                      target_p50_ms=args.target_p50_ms,
                      target_p99_ms=args.target_p99_ms)
+    dedup = None
+    if args.dedup:
+        # exactly-once retry effects (docs/SERVING_GATEWAY.md "Delivery
+        # guarantees"): with --durable the ok-reply frontier rides the
+        # entity journal's group commit and survives kill -9
+        from akka_tpu.gateway import ReplyCacheTable
+        dedup = ReplyCacheTable(window=args.dedup_window)
     server = GatewayServer(system, backend, admission, slo,
-                           port=args.port)
+                           port=args.port, dedup=dedup)
     host, port = server.start()
     print(f"READY {port}", flush=True)
 
@@ -166,7 +173,8 @@ def cmd_load(args: argparse.Namespace) -> int:
 
 # ------------------------------------------------------------------- demo
 def _spawn_serve(port: int, directory: str, restore: bool = False,
-                 devices: int = 2, durable: bool = False) -> subprocess.Popen:
+                 devices: int = 2, durable: bool = False,
+                 dedup: bool = False) -> subprocess.Popen:
     env = dict(os.environ)
     if env.get("JAX_PLATFORMS", "").startswith("cpu") or \
             "JAX_PLATFORMS" not in env:
@@ -183,6 +191,8 @@ def _spawn_serve(port: int, directory: str, restore: bool = False,
         cmd.append("--restore")
     if durable:
         cmd.append("--durable")
+    if dedup:
+        cmd.append("--dedup")
     return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True)
 
@@ -297,6 +307,12 @@ def main(argv=None) -> int:
     s.add_argument("--fsync-every-n", type=int, default=1)
     s.add_argument("--durable", action="store_true",
                    help="entity journal + durable remember-entities")
+    s.add_argument("--dedup", action="store_true",
+                   help="journaled reply-cache dedup (exactly-once "
+                        "retry effects; pair with --durable to survive "
+                        "kill -9)")
+    s.add_argument("--dedup-window", type=int, default=4096,
+                   help="remembered request ids per tenant")
     s.add_argument("--target-p50-ms", type=float, default=50.0)
     s.add_argument("--target-p99-ms", type=float, default=500.0)
 

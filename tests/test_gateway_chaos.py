@@ -71,7 +71,7 @@ def await_(cond, secs, what):
         time.sleep(0.1)
     raise AssertionError("timeout waiting for " + what)
 
-def spawn_serve(directory, restore=False, durable=False):
+def spawn_serve(directory, restore=False, durable=False, dedup=False):
     cmd = [sys.executable, EXAMPLE, "serve", "--port", str(GW_PORT),
            "--dir", directory, "--devices", "2", "--shards", "4",
            "--eps", "16", "--rate", "1000", "--burst", "500"]
@@ -79,6 +79,8 @@ def spawn_serve(directory, restore=False, durable=False):
         cmd.append("--restore")
     if durable:
         cmd.append("--durable")
+    if dedup:
+        cmd.append("--dedup")
     p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                          stderr=subprocess.STDOUT, text=True)
     deadline = time.monotonic() + dilated(120.0)
@@ -355,3 +357,196 @@ system.terminate(); system.await_termination(10)
             assert e in by_entity, (e, by_entity)
             assert floor - 1e-6 <= by_entity[e] <= r["sent_by"][e] + 1e-6, \
                 (e, floor, by_entity[e], r["sent_by"][e])
+
+
+def test_gateway_exactly_once_retry_effects():
+    """ISSUE 20 acceptance: with idempotent client sessions (every retry
+    resends the SAME request id) against a --durable --dedup gateway,
+    the conserved-value INEQUALITY upgrades to exact equality
+
+        final_total == intended_sum
+
+    under murmur3-chosen losses at every point in a request's life —
+    pre-send (connection dies before the frame leaves), in-flight (frame
+    sent, reply lost), post-ack (ack received but "lost", the client
+    resends anyway) — plus two kill -9 + --restore legs that land server
+    kills around the journal commit (post-commit-pre-ack included). Each
+    intent's value counts into intended_sum exactly ONCE no matter how
+    many wire attempts it took; duplicates are replayed from the
+    journaled reply cache, never re-applied.
+
+    The dedup-off CONTROL leg then demonstrates the bug the cache
+    removes: the same lost-ack resend against a plain --durable gateway
+    double-applies (>= 1 duplicate, final == 2x the intended value)."""
+    worker = _COMMON + r"""
+from akka_tpu.gateway.ingress import encode_frame
+from akka_tpu.testkit.chaos import chaos_hit_np
+
+system = make_system()
+seed = f"akka://mp0@127.0.0.1:{BASE_PORT}"
+node_barrier("boot", timeout=dilated(120.0))
+Cluster.get(system).join(seed)
+await_(lambda: up_count(system) == 3, 60, "3 members Up")
+node_barrier("converged", timeout=dilated(120.0))
+
+if IDX == 0:
+    # ------------------------------- gateway supervisor + kill -9 legs
+    if os.path.exists(STOP_FILE):
+        os.remove(STOP_FILE)
+    gw_dir = tempfile.mkdtemp(prefix="gw_exact_")
+    serve = spawn_serve(gw_dir, durable=True, dedup=True)
+    node_barrier("gw_up", timeout=dilated(180.0))
+    admin = GatewayClient("127.0.0.1", GW_PORT, timeout=30.0)
+
+    # two kill legs: SIGKILL lands wherever the serve path happens to
+    # be — including between the journal fsync and the ack reaching
+    # the wire (post-commit-pre-ack), the loss only the JOURNALED
+    # reply cache survives exactly-once
+    for leg in range(2):
+        time.sleep(dilated(3.0))
+        serve.send_signal(signal.SIGKILL)
+        serve.wait()
+        admin.close()
+        serve = spawn_serve(gw_dir, restore=True, durable=True,
+                            dedup=True)
+
+    time.sleep(dilated(3.0))   # post-restore traffic through the cache
+    open(STOP_FILE, "w").close()
+    node_barrier("load_done", timeout=dilated(240.0))
+
+    final = admin.request_retry("__admin", "", "sum",
+                                deadline_s=dilated(60.0))
+    stats = admin.request_retry("__admin", "", "stats",
+                                deadline_s=dilated(60.0))["data"]
+    admin.close()
+    serve.send_signal(signal.SIGTERM)
+    try:
+        serve.wait(timeout=dilated(30.0))
+    except subprocess.TimeoutExpired:
+        serve.kill()
+
+    # ---- dedup-off CONTROL: the duplicate the cache removes. A
+    # lost-ack resend of the SAME id double-applies without it.
+    ctl_dir = tempfile.mkdtemp(prefix="gw_ctl_")
+    ctl = spawn_serve(ctl_dir, durable=True)
+    cc = GatewayClient("127.0.0.1", GW_PORT, timeout=30.0)
+    creq = {"id": cc._next_id(), "tenant": "ctl", "entity": "ctl-acct",
+            "op": "add", "value": 7.0}
+    r1 = cc._request_raw(creq)
+    cc.close()                 # the ack "was lost": reconnect, resend
+    r2 = cc._request_raw(creq)
+    rg = cc.request("ctl", "ctl-acct", "get", 0.0)
+    cc.close()
+    ctl.send_signal(signal.SIGTERM)
+    try:
+        ctl.wait(timeout=dilated(30.0))
+    except subprocess.TimeoutExpired:
+        ctl.kill()
+    os.remove(STOP_FILE)
+    node_result({"role": "chaos", "final_total": float(final["value"]),
+                 "dedup": stats.get("dedup", {}),
+                 "control": {"first": r1.get("value"),
+                             "second": r2.get("value"),
+                             "second_dedup": bool(r2.get("dedup")),
+                             "total": rg.get("value")}})
+else:
+    # -------------------- idempotent-session client with chosen losses
+    node_barrier("gw_up", timeout=dilated(180.0))
+    client = GatewayClient("127.0.0.1", GW_PORT, timeout=10.0)
+    SEED = 0xE0E0 + IDX
+    intended_sum = 0.0
+    counts = {"ok": 0, "shed_waits": 0, "conn_error": 0,
+              "pre_send": 0, "mid_flight": 0, "post_ack": 0,
+              "dedup_replays": 0, "failed_intents": 0}
+    i = 0
+    while not os.path.exists(STOP_FILE):
+        i += 1
+        value = float(i % 5 + 1)
+        # one INTENT counts once, however many wire attempts it takes
+        intended_sum += value
+        req = {"id": client._next_id(), "tenant": f"tenant{IDX}",
+               "entity": f"n{IDX}-acct-{i % 4}", "op": "add",
+               "value": value}
+        # murmur3-chosen loss schedule (testkit.chaos): one lane per
+        # loss point in the request's life
+        if bool(chaos_hit_np(SEED, i, 0, 0.06)):      # pre-send
+            counts["pre_send"] += 1
+            client.close()
+        if bool(chaos_hit_np(SEED, i, 1, 0.06)):      # in-flight
+            counts["mid_flight"] += 1
+            try:
+                if client._sock is None:
+                    client.connect()
+                client._sock.sendall(encode_frame(req))
+            except (OSError, ConnectionError, socket.timeout):
+                pass
+            client.close()   # reply lost; the server MAY have applied
+        rep = None
+        deadline = time.monotonic() + dilated(120.0)
+        while time.monotonic() < deadline:
+            try:
+                rep = client._request_raw(req)   # SAME id, every attempt
+            except (OSError, ConnectionError, socket.timeout):
+                counts["conn_error"] += 1
+                client.close()
+                time.sleep(0.2)
+                continue
+            if rep.get("status") == "shed":
+                counts["shed_waits"] += 1
+                time.sleep(min(1.0, rep.get("retry_after_ms", 100) / 1e3))
+                continue
+            break
+        if rep is None or rep.get("status") != "ok":
+            counts["failed_intents"] += 1
+            continue
+        counts["ok"] += 1
+        if rep.get("dedup"):
+            counts["dedup_replays"] += 1
+        if bool(chaos_hit_np(SEED, i, 2, 0.06)):      # post-ack lost ack
+            counts["post_ack"] += 1
+            try:
+                rep2 = client._request_raw(req)
+                if rep2.get("dedup"):
+                    counts["dedup_replays"] += 1
+            except (OSError, ConnectionError, socket.timeout):
+                client.close()
+        time.sleep(0.01)
+    client.close()
+    node_barrier("load_done", timeout=dilated(240.0))
+    node_result({"role": "load", "intended_sum": intended_sum, **counts})
+
+node_barrier("done", timeout=dilated(120.0))
+system.terminate(); system.await_termination(10)
+"""
+    results, _ = spawn_nodes(worker, 3, timeout=900.0,
+                             extra_env={"AKKA_TPU_TEST_BASE_PORT": "23910"})
+    chaos = results[0]
+    loads = [results[1], results[2]]
+    assert chaos["role"] == "chaos"
+
+    # every intent resolved to an ok ack and every loss lane fired
+    for r in loads:
+        assert r["ok"] > 0 and r["failed_intents"] == 0, r
+        assert r["pre_send"] > 0 and r["mid_flight"] > 0 \
+            and r["post_ack"] > 0, r
+    assert sum(r["dedup_replays"] for r in loads) >= 1, loads
+
+    # THE exactly-once invariant: intended == final EXACTLY — no lost
+    # acked write (journal) and no double-applied retry (reply cache),
+    # across two kill -9 legs and every client-side loss lane
+    intended = sum(r["intended_sum"] for r in loads)
+    final = chaos["final_total"]
+    assert abs(final - intended) <= 1e-6, \
+        f"intended={intended} final={final} diff={final - intended}"
+
+    # the server-side cache actually served duplicates
+    dd = chaos["dedup"]
+    assert dd.get("hits", 0) + dd.get("alias_hits", 0) >= 1, dd
+
+    # dedup-off control: the SAME lost-ack resend double-applies —
+    # >= 1 duplicate, demonstrating the bug the tentpole removes
+    ctl = chaos["control"]
+    assert ctl["first"] == pytest.approx(7.0), ctl
+    assert ctl["second"] == pytest.approx(14.0), ctl   # re-applied!
+    assert not ctl["second_dedup"], ctl
+    assert ctl["total"] == pytest.approx(14.0), ctl

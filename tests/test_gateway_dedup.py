@@ -1,0 +1,234 @@
+"""Journaled reply-cache dedup (akka_tpu/gateway/dedup.py) — the server
+half of exactly-once effects (ISSUE 20).
+
+Tier-1 scope: ReplyCacheTable unit contracts (window eviction,
+LRU spill + bit-exact rehydrate, pending/inflight, journal-order load)
+plus cheap in-proc gateway legs on the virtual CPU mesh: duplicate
+retries replay the cached reply on BOTH encodings without re-applying,
+evicted ids re-apply (the documented at-least-once degradation), and
+idempotent client sessions mint stable ids. The kill -9 + restore
+rehydration legs live in tests/test_gateway_chaos.py (slow tier)."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from akka_tpu.gateway import (AdmissionController, GatewayClient,
+                              GatewayServer, RegionBackend, ReplyCacheTable,
+                              SloTracker, counter_behavior)
+from akka_tpu.gateway.dedup import DUPLICATE_INFLIGHT
+from akka_tpu.gateway.ingress import encode_body
+from akka_tpu.serialization import frames
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ------------------------------------------------------- table contracts
+def test_reply_cache_miss_record_hit_roundtrip():
+    dd = ReplyCacheTable(window=16)
+    key = ("t0", 101)
+    (v,) = dd.begin([key])
+    assert v == ("miss",)
+    dd.record(key, frames.ST_OK, 7.5)
+    (v,) = dd.begin([key])
+    assert v == ("hit", frames.ST_OK, 7.5, b"")
+    st = dd.stats()
+    assert st["hits"] == 1 and st["misses"] == 1 and st["records"] == 1
+
+
+def test_reply_cache_release_lets_retry_run_fresh():
+    dd = ReplyCacheTable()
+    key = ("t0", 1)
+    assert dd.begin([key]) == [("miss",)]
+    dd.release(key)  # shed/fault: nothing applied, nothing cached
+    assert dd.begin([key]) == [("miss",)]
+    assert dd.lookup(key) is None
+
+
+def test_reply_cache_same_window_alias_and_inflight():
+    dd = ReplyCacheTable()
+    a, b = ("t0", 5), ("t0", 6)
+    # duplicate INSIDE one window aliases its source row, not a shed
+    out = dd.begin([a, b, a, None])
+    assert out == [("miss",), ("miss",), ("alias", 0), ("skip",)]
+    # duplicate ACROSS windows while the first attempt is still pending
+    # is the typed inflight shed — never a second application
+    assert dd.begin([a]) == [("inflight",)]
+    assert dd.stats()["inflight_sheds"] == 1
+    assert DUPLICATE_INFLIGHT == "duplicate_inflight"
+
+
+def test_reply_cache_pending_ttl_expiry_degrades_to_miss():
+    clk = FakeClock()
+    dd = ReplyCacheTable(pending_ttl_s=30.0, clock=clk)
+    key = ("t0", 9)
+    assert dd.begin([key]) == [("miss",)]
+    clk.advance(10.0)
+    assert dd.begin([key]) == [("inflight",)]
+    clk.advance(31.0)  # a crashed serve path leaked the pending mark
+    assert dd.begin([key]) == [("miss",)]
+    assert dd.stats()["pending_expired"] == 1
+
+
+def test_reply_cache_window_eviction_is_per_tenant():
+    dd = ReplyCacheTable(window=2)
+    for rid in (1, 2, 3):
+        dd.record(("t0", rid), frames.ST_OK, float(rid))
+    dd.record(("t1", 1), frames.ST_OK, 9.0)
+    # t0's oldest id fell off the 2-id window: FORGOTTEN entirely
+    assert dd.lookup(("t0", 1)) is None
+    assert dd.begin([("t0", 1)]) == [("miss",)]
+    dd.release(("t0", 1))
+    # the newer two ids and the OTHER tenant's frontier are untouched
+    assert dd.lookup(("t0", 2)) == (frames.ST_OK, 2.0, b"")
+    assert dd.lookup(("t0", 3)) == (frames.ST_OK, 3.0, b"")
+    assert dd.lookup(("t1", 1)) == (frames.ST_OK, 9.0, b"")
+    assert dd.stats()["window_evictions"] == 1
+
+
+def test_reply_cache_spill_rehydrate_bit_identical():
+    dd = ReplyCacheTable(window=64, max_resident=2, init_capacity=2)
+    v1 = 0.1 + 0.2  # a value whose f64 bits are easy to get wrong
+    dd.record(("t0", 1), frames.ST_OK, v1)
+    dd.record(("t0", 2), frames.ST_ERROR, 0.0, b"timeout")
+    dd.record(("t0", 3), frames.ST_OK, 3.25)  # LRU-spills ("t0", 1)
+    st = dd.stats()
+    assert st["spills"] == 1 and st["resident"] == 2 and st["spilled"] == 1
+    # spilled rows keep RAW scalars: the point probe and the begin()
+    # rehydrate must both return the exact f64 bit pattern
+    got = dd.lookup(("t0", 1))
+    assert got is not None
+    assert np.float64(got[1]).tobytes() == np.float64(v1).tobytes()
+    (v,) = dd.begin([("t0", 1)])
+    assert v[0] == "hit"
+    assert np.float64(v[2]).tobytes() == np.float64(v1).tobytes()
+    assert dd.stats()["rehydrates"] == 1
+    # error replies rehydrate their interned reason too
+    dd.record(("t0", 4), frames.ST_OK, 4.0)  # spills another row
+    assert dd.lookup(("t0", 2)) == (frames.ST_ERROR, 0.0, b"timeout")
+
+
+def test_reply_cache_load_applies_window_in_journal_order():
+    dd = ReplyCacheTable(window=2)
+    n = dd.load([("t0", i, frames.ST_OK, float(i)) for i in (1, 2, 3)])
+    assert n == 3
+    st = dd.stats()
+    assert st["loads"] == 3 and st["records"] == 0  # loads are not live
+    # journal longer than the window keeps only the NEWEST window ids —
+    # exactly the frontier the live path would have kept
+    assert dd.lookup(("t0", 1)) is None
+    assert dd.lookup(("t0", 2)) == (frames.ST_OK, 2.0, b"")
+    assert dd.lookup(("t0", 3)) == (frames.ST_OK, 3.0, b"")
+
+
+# -------------------------------------------------- idempotent sessions
+def test_client_session_ids_are_stable_and_positive():
+    c = GatewayClient("127.0.0.1", 1, session=0xDEADBEEFCAFE)
+    ids = [c._next_id() for _ in range(3)]
+    assert all(i > 0 for i in ids)
+    # (session << 24) | seq, masked positive: consecutive ids differ
+    # only in the 24-bit seq, the session tag is stable
+    assert [i & 0xFFFFFF for i in ids] == [1, 2, 3]
+    assert len({i >> 24 for i in ids}) == 1
+    assert ids[0] >> 24 == (0xDEADBEEFCAFE << 24 & 0x7FFFFFFFFFFFFFFF) >> 24
+    # two clients NEVER share a session tag by construction
+    c2 = GatewayClient("127.0.0.1", 1, session=0xDEADBEEFCAFF)
+    assert c2._next_id() != ids[0]
+
+
+# ------------------------------------------------- in-proc gateway legs
+@pytest.fixture(scope="module")
+def small_region():
+    from akka_tpu.sharding.device import DeviceEntity, DeviceShardRegion
+    spec = DeviceEntity("gwd", counter_behavior(4), n_shards=2,
+                        entities_per_shard=8, n_devices=2, payload_width=4)
+    return DeviceShardRegion(spec)
+
+
+def _server(region, dedup):
+    return GatewayServer(None, RegionBackend(region),
+                         AdmissionController(rate=1e6, burst=1e6),
+                         SloTracker(), dedup=dedup)
+
+
+def _req(server, tenant, entity, op, value=0.0, rid=1):
+    body = encode_body({"id": rid, "tenant": tenant, "entity": entity,
+                        "op": op, "value": value})
+    return json.loads(server.handle_frame(body))
+
+
+def test_gateway_duplicate_retry_replays_without_reapply(small_region):
+    srv = _server(small_region, ReplyCacheTable())
+    first = _req(srv, "t0", "acct-0", "add", 5.0, rid=77)
+    assert first["status"] == "ok" and "dedup" not in first
+    replay = _req(srv, "t0", "acct-0", "add", 5.0, rid=77)
+    # identical reply content, marked as a replay, effect applied ONCE
+    assert replay["dedup"] is True
+    assert (replay["id"], replay["status"], replay["value"]) == \
+        (first["id"], first["status"], first["value"])
+    assert _req(srv, "t0", "acct-0", "get", rid=78)["value"] == \
+        pytest.approx(first["value"])
+    st = srv.dedup.stats()
+    assert st["hits"] == 1 and st["records"] >= 2
+
+
+def test_gateway_same_window_duplicate_both_encodings(small_region):
+    # BINARY: two records with the SAME id inside ONE 0xAB window — the
+    # alias row copies its source row's resolved reply
+    srv = _server(small_region, ReplyCacheTable())
+    body = frames.encode_request_batch(
+        [501, 501], ["t0", "t0"], ["acct-1", "acct-1"],
+        ["add", "add"], [4.0, 4.0])
+    reps = frames.decode_replies(srv.handle_frame(body))
+    assert reps[0]["status"] == "ok" and "dedup" not in reps[0]
+    assert reps[1]["dedup"] is True
+    assert (reps[1]["id"], reps[1]["status"], reps[1]["value"]) == \
+        (reps[0]["id"], reps[0]["status"], reps[0]["value"])
+    assert srv.dedup.stats()["alias_hits"] == 1
+    # applied once: the counter saw ONE add
+    assert _req(srv, "t0", "acct-1", "get", rid=502)["value"] == \
+        pytest.approx(reps[0]["value"])
+    # JSON path against the SAME cache: a cross-encoding retry of the
+    # binary-minted id replays the identical reply content
+    rep = _req(srv, "t0", "acct-1", "add", 4.0, rid=501)
+    assert rep["dedup"] is True and rep["value"] == reps[0]["value"]
+
+
+def test_gateway_evicted_id_reapplies_at_least_once(small_region):
+    # window=1: recording id B forgets id A; a retry of A re-applies —
+    # the documented per-tenant at-least-once degradation
+    srv = _server(small_region, ReplyCacheTable(window=1))
+    a = _req(srv, "t0", "acct-2", "add", 2.0, rid=601)
+    assert a["status"] == "ok"
+    b = _req(srv, "t0", "acct-2", "add", 3.0, rid=602)
+    assert b["status"] == "ok" and b["value"] == a["value"] + 3.0
+    retry_a = _req(srv, "t0", "acct-2", "add", 2.0, rid=601)
+    assert retry_a["status"] == "ok" and "dedup" not in retry_a
+    assert retry_a["value"] == pytest.approx(b["value"] + 2.0)
+    assert srv.dedup.stats()["window_evictions"] >= 1
+
+
+def test_gateway_dedup_is_post_admission(small_region):
+    # a duplicate of a cached id still pays the admission charge: a
+    # zero-budget tenant's retry sheds, it does NOT get a cached reply
+    dd = ReplyCacheTable()
+    srv = GatewayServer(None, RegionBackend(small_region),
+                        AdmissionController(rate=0.001, burst=1.0),
+                        SloTracker(), dedup=dd)
+    first = _req(srv, "t9", "acct-3", "add", 1.0, rid=701)
+    assert first["status"] == "ok"
+    retry = _req(srv, "t9", "acct-3", "add", 1.0, rid=701)
+    assert retry["status"] == "shed" and "dedup" not in retry
+    assert dd.stats()["hits"] == 0

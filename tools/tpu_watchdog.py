@@ -540,6 +540,31 @@ def on_tpu_found(detail: str) -> None:
                             "continuous_speedup_64":
                                 ca.get("speedup_64"),
                             "conserved": ca.get("conserved")})
+            dd = gw.get("dedup_ab", {})
+            if dd:
+                # exactly-once retry (ISSUE 20): journaled reply-cache
+                # dedup on vs off on the 64-client batched leg, unique
+                # ids (the hot non-duplicate path), equal admission;
+                # acceptance is dedup-on req/s >= 0.95x dedup-off with
+                # the replay coda proving acked ids short-circuit from
+                # the cache (dedup:true) without re-applying
+                append_log({"ts": _utcnow(),
+                            "ok": bool(dd.get("ok")) and
+                                  bool(dd.get("equal_admission")),
+                            "detail": "exactly-once retry "
+                                      "(reply-cache dedup on/off, "
+                                      "equal admission)",
+                            "dedup_req_per_sec_ratio":
+                                dd.get("req_per_sec_ratio"),
+                            "dedup_on_req_per_sec":
+                                dd.get("dedup_on", {})
+                                .get("req_per_sec"),
+                            "dedup_off_req_per_sec":
+                                dd.get("dedup_off", {})
+                                .get("req_per_sec"),
+                            "replayed_no_reapply":
+                                dd.get("replayed_no_reapply"),
+                            "conserved": dd.get("conserved")})
     # C1M front door (ISSUE 18): selector evloop vs thread-per-connection
     # stream transport over real TCP at equal admission — the row is ok
     # when evloop req/s >= 2x the threaded leg with identical
@@ -577,7 +602,12 @@ def on_tpu_found(detail: str) -> None:
                             fd.get("fd_budget", {})
                             .get("max_inproc_connections"),
                         "read_pauses":
-                            el.get("evloop", {}).get("read_pauses")})
+                            el.get("evloop", {}).get("read_pauses"),
+                        "binary_window_speedup":
+                            fd.get("binary_window", {}).get("speedup"),
+                        "binary_vs_json_evloop":
+                            fd.get("binary_window", {})
+                            .get("vs_json_evloop")})
     # wire-decode throughput: batch np.frombuffer vs json.loads, plus the
     # full-path 1/8/64-client encoding sweep (docs/SERVING_GATEWAY.md
     # wire-protocol section)
